@@ -1,0 +1,31 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up re-design of the capability set of deeplearning4j
+(reference: yichencc/deeplearning4j) for TPU hardware:
+
+* the libnd4j/JavaCPP native core is replaced by the PJRT runtime that jax
+  already drives — arrays live in TPU HBM as jax Arrays;
+* the SameDiff interpreter is replaced by traced, XLA-compiled programs
+  (one compiled step per ``fit`` loop instead of one JNI crossing per op);
+* ``MultiLayerNetwork``/``ComputationGraph`` keep their declarative,
+  JSON-round-trippable configuration surface but build pure ``init/apply``
+  functions over parameter pytrees;
+* the cuDNN/oneDNN layer helpers are XLA lowerings — no helper seam exists;
+* ParallelWrapper / SharedTrainingMaster / Aeron are replaced by a single
+  sharded train step over a ``jax.sharding.Mesh`` (ICI/DCN collectives).
+
+Reference parity citations in docstrings use the upstream monorepo layout
+(e.g. ``deeplearning4j/deeplearning4j-nn/.../MultiLayerNetwork.java``); see
+SURVEY.md for the full component inventory this package mirrors.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+
+__all__ = [
+    "NeuralNetConfiguration",
+    "MultiLayerNetwork",
+    "__version__",
+]

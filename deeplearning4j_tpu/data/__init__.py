@@ -1,0 +1,29 @@
+"""Data pipeline: DataSet containers, iterators, normalizers, built-in datasets.
+
+TPU-native twin of the ND4J dataset API + DataVec ETL (reference:
+``org.nd4j.linalg.dataset.{DataSet,MultiDataSet}``,
+``org.nd4j.linalg.dataset.api.iterator.DataSetIterator``,
+``org.deeplearning4j.datasets.iterator.*``, ``datavec/*``).  Host-side data
+stays numpy; device transfer happens once per batch at the jit boundary
+(sharded device_put when a mesh is active).
+"""
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterator import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ExistingDataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.data.normalization import (
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
+
+__all__ = [
+    "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
+    "ExistingDataSetIterator", "AsyncDataSetIterator",
+    "NormalizerStandardize", "NormalizerMinMaxScaler",
+    "ImagePreProcessingScaler",
+]

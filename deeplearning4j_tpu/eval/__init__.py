@@ -1,0 +1,14 @@
+"""Evaluation: classification, binary, regression metrics, ROC.
+
+TPU-native twin of ``org.nd4j.evaluation.*`` (``Evaluation``,
+``EvaluationBinary``, ``RegressionEvaluation``, ``ROC``/``ROCMultiClass``).
+Accumulation is streaming (call ``eval`` per batch) like DL4J, so large
+test sets never materialize at once.
+"""
+
+from deeplearning4j_tpu.eval.classification import Evaluation, EvaluationBinary
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
+
+__all__ = ["Evaluation", "EvaluationBinary", "RegressionEvaluation", "ROC",
+           "ROCMultiClass"]

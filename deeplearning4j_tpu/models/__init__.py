@@ -1,0 +1,13 @@
+"""Model classes: sequential MultiLayerNetwork and DAG ComputationGraph.
+
+TPU-native twin of ``org.deeplearning4j.nn.multilayer.MultiLayerNetwork``
+and ``org.deeplearning4j.nn.graph.ComputationGraph``.  Same public training
+semantics (fit/output/score/evaluate, listeners, serialization), but the
+whole train iteration is one compiled XLA program instead of eager per-op
+dispatch, and parameters are pytrees instead of one flattened vector with
+per-layer views (a flattened view is still offered for parity).
+"""
+
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+
+__all__ = ["MultiLayerNetwork"]

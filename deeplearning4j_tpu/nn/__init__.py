@@ -1,0 +1,7 @@
+"""Neural-network building blocks: activations, initializers, losses, layers.
+
+TPU-native twin of ``deeplearning4j/deeplearning4j-nn`` — but where DL4J
+splits each layer into a conf class + an eager runtime class +
+backend-specific helpers (cuDNN/oneDNN), here a layer is one dataclass
+config that owns pure ``init``/``apply`` functions lowered through XLA.
+"""

@@ -1,0 +1,62 @@
+"""Activation functions.
+
+Parity with DL4J's ``Activation`` enum (reference:
+``nd4j-api org.nd4j.linalg.activations.Activation`` — CUBE, ELU, HARDSIGMOID,
+HARDTANH, IDENTITY, LEAKYRELU, RATIONALTANH, RELU, RELU6, RRELU, SELU,
+SIGMOID, SOFTMAX, SOFTPLUS, SOFTSIGN, SWISH, TANH, THRESHOLDEDRELU, GELU,
+MISH).  All are pure jnp functions so XLA fuses them into the surrounding
+matmul/conv — the fusion DL4J needed cuDNN activation descriptors for.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_E = 1e-7
+
+
+def _rational_tanh(x):
+    # tanh approximation from DL4J's RATIONALTANH (Anguita et al.)
+    a = 1.7159
+    y = a * _rational_core((2.0 / 3.0) * x)
+    return jnp.clip(y, -a, a)
+
+
+def _rational_core(x):
+    ax = jnp.abs(x)
+    return jnp.sign(x) * (1.0 - 1.0 / (1.0 + ax + x * x + 1.41645 * x**4))
+
+
+ACTIVATIONS = {
+    "identity": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "celu": jax.nn.celu,
+    "gelu": jax.nn.gelu,
+    "sigmoid": jax.nn.sigmoid,
+    "hardsigmoid": jax.nn.hard_sigmoid,
+    "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
+    "tanh": jnp.tanh,
+    "rationaltanh": _rational_tanh,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "logsoftmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "swish": jax.nn.swish,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "cube": lambda x: x**3,
+    "thresholdedrelu": lambda x: jnp.where(x > 1.0, x, 0.0),
+}
+
+
+def get_activation(name: str):
+    """Look up an activation by DL4J enum name (case-insensitive)."""
+    fn = ACTIVATIONS.get(str(name).lower())
+    if fn is None:
+        raise ValueError(
+            f"Unknown activation {name!r}; available: {sorted(ACTIVATIONS)}"
+        )
+    return fn

@@ -1,0 +1,22 @@
+"""Declarative, JSON-round-trippable network configuration.
+
+TPU-native twin of ``org.deeplearning4j.nn.conf`` (NeuralNetConfiguration
+builder -> MultiLayerConfiguration JSON).  Unlike DL4J — where a conf class
+is paired with a separate eager runtime Layer class and optional
+cuDNN/oneDNN helpers — here each layer config directly owns pure
+``init``/``apply`` functions that XLA compiles; there is no helper seam.
+"""
+
+from deeplearning4j_tpu.nn.conf.base import BaseLayerConf, layer_from_dict, register_layer
+from deeplearning4j_tpu.nn.conf.builder import (
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+
+__all__ = [
+    "BaseLayerConf",
+    "layer_from_dict",
+    "register_layer",
+    "NeuralNetConfiguration",
+    "MultiLayerConfiguration",
+]

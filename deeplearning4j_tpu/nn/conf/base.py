@@ -1,0 +1,132 @@
+"""Layer-config base classes and serialization registry.
+
+Replaces DL4J's Jackson-polymorphic layer conf hierarchy (reference:
+``org.deeplearning4j.nn.conf.layers.Layer`` + ``@JsonTypeInfo`` subtype
+registry).  A layer here is ONE dataclass that carries:
+
+* hyperparameters (serialized to/from JSON via ``to_dict``/``from_dict``),
+* ``infer_shapes(input_shape)`` — InputType propagation (DL4J
+  ``Layer.getOutputType`` + ``setNIn``),
+* ``init(key, dtype) -> (params, state)`` — parameter pytree construction
+  (DL4J ``ParamInitializer``),
+* ``apply(params, state, x, training, rng, compute_dtype) -> (y, state)`` —
+  the pure forward, traced and compiled by XLA.  Backward is ``jax.grad`` —
+  there is no ``backpropGradient`` twin to hand-write.
+
+Shape convention: batch-major; images are NHWC (TPU-native), sequences are
+[batch, time, features] — time-major conversion happens at the scan, not in
+user-facing shapes.  (DL4J uses NCHW / [b, f, t]; the data pipeline adapts.)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+_LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def register_layer(cls):
+    """Class decorator: register for polymorphic JSON round-trip."""
+    _LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def layer_from_dict(d: Dict[str, Any]) -> "BaseLayerConf":
+    d = dict(d)
+    type_name = d.pop("type")
+    cls = _LAYER_REGISTRY.get(type_name)
+    if cls is None:
+        raise ValueError(f"Unknown layer type {type_name!r} in config")
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in d.items() if k in field_names})
+
+
+@dataclasses.dataclass
+class BaseLayerConf:
+    """Common hyperparameters every DL4J ``BaseLayer`` carries.
+
+    ``None`` means "inherit from the global NeuralNetConfiguration" — the
+    builder resolves these before the model is built (DL4J does the same
+    via ``NeuralNetConfiguration.Builder`` global defaults).
+    """
+
+    # Input kinds this layer consumes, in preference order; the builder
+    # auto-inserts reshape preprocessors (DL4J InputPreProcessor insertion).
+    WANTED_KINDS = ("any",)
+
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    weight_distribution: Optional[dict] = None
+    bias_init: float = 0.0
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    weight_decay: Optional[float] = None
+    dropout: Optional[float] = None  # DROP probability (DL4J stores keep)
+    updater: Optional[dict] = None   # per-layer updater override
+    learning_rate_mult: float = 1.0  # analogue of per-layer lr override
+
+    # ---- serialization ----
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"type": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v is not None and v != f.default:
+                d[f.name] = v
+        return d
+
+    # ---- to be overridden ----
+    def infer_shapes(self, input_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Propagate the (batch-free) input shape; fill in n_in if unset."""
+        return input_shape
+
+    def has_params(self) -> bool:
+        return False
+
+    def init(self, key, dtype=jnp.float32):
+        """Return (params, state) pytrees (both possibly empty dicts)."""
+        return {}, {}
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        raise NotImplementedError
+
+    # weight-carrying params that regularization applies to (not biases)
+    def regularized_param_names(self):
+        return ("W",) if self.has_params() else ()
+
+    def resolve_defaults(self, global_conf: "GlobalConf"):
+        """Fill None fields from global conf (builder-time)."""
+        if self.activation is None:
+            self.activation = global_conf.activation
+        if self.weight_init is None:
+            self.weight_init = global_conf.weight_init
+        if self.weight_distribution is None:
+            self.weight_distribution = global_conf.weight_distribution
+        if self.l1 is None:
+            self.l1 = global_conf.l1
+        if self.l2 is None:
+            self.l2 = global_conf.l2
+        if self.weight_decay is None:
+            self.weight_decay = global_conf.weight_decay
+        if self.dropout is None:
+            self.dropout = global_conf.dropout
+
+
+@dataclasses.dataclass
+class GlobalConf:
+    """Global defaults layers inherit (DL4J builder's top-level settings)."""
+
+    seed: int = 0
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    weight_distribution: Optional[dict] = None
+    l1: float = 0.0
+    l2: float = 0.0
+    weight_decay: float = 0.0
+    dropout: float = 0.0
+    updater: Optional[dict] = None
+    dtype: str = "float32"
+    minimize: bool = True

@@ -1,0 +1,126 @@
+"""Input types and automatic shape preprocessors.
+
+Parity with ``org.deeplearning4j.nn.conf.inputs.InputType`` (FF / recurrent /
+convolutional) and the ``InputPreProcessor`` family
+(``CnnToFeedForwardPreProcessor``, ``FeedForwardToCnnPreProcessor``,
+``RnnToFeedForwardPreProcessor``, ``FeedForwardToRnnPreProcessor``,
+``RnnToCnnPreProcessor``, ``CnnToRnnPreProcessor``).
+
+DL4J stores images NCHW; this framework is NHWC end-to-end (the layout the
+TPU conv lowering wants), so "convolutional(h, w, c)" here means a
+[batch, h, w, c] tensor.  Recurrent data is [batch, time, features]
+(DL4J uses [batch, features, time]; iterators adapt).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    """kind: 'ff' (features,), 'cnn' (h, w, c), 'rnn' (time, features).
+    Shapes are batch-free; time may be None (dynamic — resolved per batch).
+    """
+
+    kind: str
+    shape: Tuple[Optional[int], ...]
+
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType("ff", (int(size),))
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType("cnn", (int(height), int(width), int(channels)))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        # DL4J's convolutionalFlat: data arrives flattened, first layer conv
+        return InputType("cnn_flat", (int(height), int(width), int(channels)))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType("rnn", (timesteps, int(size)))
+
+    def flat_size(self) -> int:
+        n = 1
+        for s in self.shape:
+            if s is not None:
+                n *= s
+        return n
+
+    def to_dict(self):
+        return {"kind": self.kind, "shape": list(self.shape)}
+
+    @staticmethod
+    def from_dict(d):
+        return InputType(d["kind"], tuple(d["shape"]))
+
+
+# ---------------------------------------------------------------------------
+# Preprocessors — pure reshape adapters auto-inserted between layer kinds.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Preprocessor:
+    """name identifies the reshape; spec carries static dims it needs."""
+
+    name: str
+    spec: Tuple[int, ...] = ()
+
+    def __call__(self, x):
+        if self.name == "cnn_to_ff":          # [b,h,w,c] -> [b, h*w*c]
+            return x.reshape(x.shape[0], -1)
+        if self.name == "ff_to_cnn":          # [b, n] -> [b,h,w,c]
+            h, w, c = self.spec
+            return x.reshape(x.shape[0], h, w, c)
+        if self.name == "rnn_to_ff":          # [b,t,f] -> [b*t, f]
+            return x.reshape(-1, x.shape[-1])
+        if self.name == "ff_to_rnn":          # [b*t, f] -> [b,t,f]
+            (t,) = self.spec
+            return x.reshape(-1, t, x.shape[-1])
+        if self.name == "cnn_to_rnn":         # [b,h,w,c] -> [b, h*w, c]? DL4J: time=h*w? No:
+            # DL4J CnnToRnn: [b,c,h,w] -> [b, c*h*w over time]? Actually maps
+            # width as time: [b,h,w,c] -> [b, w, h*c]
+            return x.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[2], -1)
+        if self.name == "identity":
+            return x
+        raise ValueError(f"Unknown preprocessor {self.name!r}")
+
+    def to_dict(self):
+        return {"name": self.name, "spec": list(self.spec)}
+
+    @staticmethod
+    def from_dict(d):
+        return Preprocessor(d["name"], tuple(d.get("spec", ())))
+
+
+def adapt(input_type: InputType, wanted_kind: str):
+    """Return (preprocessor | None, new InputType) adapting `input_type` to
+    the kind a layer wants ('ff'/'cnn'/'rnn'/'any').  Mirrors DL4J's
+    automatic InputPreProcessor insertion in
+    ``MultiLayerConfiguration.Builder#build``."""
+    kind = input_type.kind
+    if wanted_kind in ("any", kind):
+        return None, input_type
+    if kind == "cnn_flat" and wanted_kind == "cnn":
+        h, w, c = input_type.shape
+        return Preprocessor("ff_to_cnn", (h, w, c)), InputType("cnn", (h, w, c))
+    if kind == "cnn_flat" and wanted_kind == "ff":
+        return None, InputType("ff", (input_type.flat_size(),))
+    if kind == "cnn" and wanted_kind == "ff":
+        return Preprocessor("cnn_to_ff"), InputType("ff", (input_type.flat_size(),))
+    if kind == "ff" and wanted_kind == "cnn":
+        raise ValueError("ff->cnn requires explicit InputType.convolutional_flat")
+    if kind == "cnn" and wanted_kind == "rnn":
+        h, w, c = input_type.shape
+        return Preprocessor("cnn_to_rnn"), InputType("rnn", (w, h * c))
+    if kind == "rnn" and wanted_kind == "ff":
+        t, f = input_type.shape
+        # Dense over every timestep: fold time into batch (DL4J
+        # RnnToFeedForwardPreProcessor semantics); restored by ff_to_rnn.
+        return Preprocessor("rnn_to_ff"), InputType("ff", (f,))
+    raise ValueError(f"No preprocessor from {kind!r} to {wanted_kind!r}")

@@ -1,0 +1,216 @@
+"""Feed-forward layer configs: Dense, Output, Activation, Dropout, Embedding.
+
+Parity targets (reference paths, upstream layout):
+* ``org.deeplearning4j.nn.conf.layers.DenseLayer`` + runtime
+  ``org.deeplearning4j.nn.layers.feedforward.dense.DenseLayer``
+* ``org.deeplearning4j.nn.conf.layers.OutputLayer`` + runtime
+  ``org.deeplearning4j.nn.layers.BaseOutputLayer`` (loss integration)
+* ``EmbeddingLayer`` / ``EmbeddingSequenceLayer``
+* ``ActivationLayer``, ``DropoutLayer``, ``LossLayer``
+
+Each DL4J runtime class hand-writes ``activate`` + ``backpropGradient``;
+here only the forward exists (jax.grad supplies the backward), and XLA fuses
+bias+activation into the matmul — the work DL4J delegated to cuDNN.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.base import BaseLayerConf, register_layer
+from deeplearning4j_tpu.nn.losses import FUSED_ACTIVATIONS, get_loss
+from deeplearning4j_tpu.nn.weights_init import init_weights
+
+
+def apply_dropout(x, rate: float, training: bool, rng):
+    """Inverted dropout.  DL4J's ``dropOut(p)`` takes a RETAIN probability
+    (``org.deeplearning4j.nn.conf.dropout.Dropout``); our configs store the
+    DROP rate (pythonic); conversion happens in the compat shims."""
+    if not training or not rate or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+@register_layer
+@dataclasses.dataclass
+class DenseLayer(BaseLayerConf):
+    """Fully connected layer: y = act(x @ W + b)."""
+
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    has_bias: bool = True
+
+    # Dense applies over the last axis, so it natively consumes flat [b, f]
+    # and sequence [b, t, f] inputs (XLA batches the matmul); conv inputs
+    # are flattened by an auto-inserted preprocessor.
+    WANTED_KINDS = ("ff", "rnn")
+
+    def infer_shapes(self, input_shape):
+        if self.n_in is None:
+            self.n_in = int(input_shape[-1])
+        return tuple(input_shape[:-1]) + (self.n_out,)
+
+    def has_params(self):
+        return True
+
+    def init(self, key, dtype=jnp.float32):
+        w = init_weights(
+            key, (self.n_in, self.n_out), self.n_in, self.n_out,
+            self.weight_init, dtype, self.weight_distribution,
+        )
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params, {}
+
+    def pre_output(self, params, x, compute_dtype=None):
+        w = params["W"]
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+            w = w.astype(compute_dtype)
+        z = x @ w
+        if self.has_bias:
+            z = z + params["b"].astype(z.dtype)
+        return z.astype(params["W"].dtype)
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        z = self.pre_output(params, x, compute_dtype)
+        y = get_activation(self.activation or "identity")(z)
+        y = apply_dropout(y, self.dropout, training, rng)
+        return y, state
+
+
+@register_layer
+@dataclasses.dataclass
+class ActivationLayer(BaseLayerConf):
+    """Standalone activation (``org.deeplearning4j.nn.conf.layers.ActivationLayer``)."""
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        return get_activation(self.activation or "identity")(x), state
+
+
+@register_layer
+@dataclasses.dataclass
+class DropoutLayer(BaseLayerConf):
+    """Standalone dropout (``DropoutLayer``); `rate` is the drop probability."""
+
+    rate: float = 0.5
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        return apply_dropout(x, self.rate, training, rng), state
+
+
+@register_layer
+@dataclasses.dataclass
+class EmbeddingLayer(BaseLayerConf):
+    """Index -> vector lookup (``EmbeddingLayer``): input [batch] or
+    [batch,1] of int ids, output [batch, n_out].  On TPU this is a gather —
+    one-hot matmul is used for tiny vocabularies where MXU beats gather."""
+
+    n_in: Optional[int] = None  # vocabulary size
+    n_out: Optional[int] = None
+    has_bias: bool = False
+
+    def infer_shapes(self, input_shape):
+        return (self.n_out,)
+
+    def has_params(self):
+        return True
+
+    def init(self, key, dtype=jnp.float32):
+        w = init_weights(key, (self.n_in, self.n_out), self.n_in, self.n_out,
+                         self.weight_init, dtype, self.weight_distribution)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim >= 2 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        y = jnp.take(params["W"], idx, axis=0)
+        if self.has_bias:
+            y = y + params["b"]
+        y = get_activation(self.activation or "identity")(y)
+        return y, state
+
+
+@dataclasses.dataclass
+class BaseOutputLayerConf(BaseLayerConf):
+    """Shared loss plumbing for Output/RnnOutput/Loss layers
+    (``org.deeplearning4j.nn.layers.BaseOutputLayer``)."""
+
+    loss: str = "mcxent"
+
+    def per_example_score(self, labels, z, mask=None):
+        """Per-example loss from PRE-activation z, fusing softmax/sigmoid
+        into the loss when numerically profitable (LossMCXENT's fused path).
+
+        Sequence outputs ([b, t, c]) are scored per timestep by folding
+        time into the batch, so a label mask [b, t] (or [b, t, 1]) weights
+        individual timesteps — DL4J's per-timestep masked scoring in
+        ``BaseOutputLayer.computeScore`` with ``LossUtil`` masking.
+        Mask shapes [b] and [b, 1] weight whole examples.
+        """
+        act = (self.activation or "identity").lower()
+        loss_name = str(self.loss).lower()
+        loss_fn = get_loss(loss_name)
+
+        seq = z.ndim == 3
+        if seq:
+            b, t = z.shape[0], z.shape[1]
+            z2 = z.reshape(b * t, z.shape[-1])
+            lab2 = (labels.reshape(b * t, labels.shape[-1])
+                    if labels.ndim == 3 else labels.reshape(b * t))
+        else:
+            z2, lab2 = z, labels
+
+        if FUSED_ACTIVATIONS.get(loss_name) == act:
+            scores = loss_fn(lab2, None, logits=z2)
+        else:
+            scores = loss_fn(lab2, get_activation(act)(z2))
+
+        if seq:
+            scores = scores.reshape(b, t)
+            if mask is not None:
+                m = mask[..., 0] if mask.ndim == 3 else mask
+                scores = scores * m
+            scores = jnp.sum(scores, axis=1)
+        elif mask is not None:
+            scores = scores * mask.reshape(scores.shape[0])
+        return scores
+
+
+@register_layer
+@dataclasses.dataclass
+class OutputLayer(BaseOutputLayerConf, DenseLayer):
+    """Dense + loss head (``org.deeplearning4j.nn.conf.layers.OutputLayer``)."""
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        z = self.pre_output(params, x, compute_dtype)
+        return get_activation(self.activation or "identity")(z), state
+
+
+@register_layer
+@dataclasses.dataclass
+class LossLayer(BaseOutputLayerConf):
+    """Loss without params (``org.deeplearning4j.nn.conf.layers.LossLayer``)."""
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        return get_activation(self.activation or "identity")(x), state
+
+    def pre_output(self, params, x, compute_dtype=None):
+        return x

@@ -1,0 +1,166 @@
+"""Loss functions.
+
+Parity with DL4J's ``LossFunctions.LossFunction`` zoo (reference:
+``nd4j-api org.nd4j.linalg.lossfunctions.impl.{LossMCXENT,LossNegativeLogLikelihood,
+LossMSE,LossL1,LossBinaryXENT,LossHinge,LossSquaredHinge,LossKLD,LossPoisson,
+LossCosineProximity,LossMixtureDensity,…}``).
+
+Semantics that matter for loss-curve parity with DL4J:
+
+* every loss is averaged over the minibatch (DL4J ``computeScore`` divides
+  by example count), and summed over output dimensions within an example;
+* MCXENT expects the activation already applied (softmax output) — like
+  DL4J, we fuse softmax+xent numerically when the output layer's activation
+  is softmax, by computing from logits via log_softmax;
+* per-example mask weights (label masks) multiply per-example scores.
+
+Each entry maps name -> fn(labels, preds_or_logits, from_logits) returning
+per-example scores of shape [batch].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def _sum_features(x):
+    # Sum across all non-batch axes: handles 2-D dense, 4-D conv, 3-D time.
+    return jnp.sum(x, axis=tuple(range(1, x.ndim)))
+
+
+def mcxent(labels, preds, logits=None):
+    """Multi-class cross entropy. If `logits` given, computes via
+    log_softmax for numerical stability (the fused softmax+MCXENT path that
+    DL4J special-cases in ``LossMCXENT`` when paired with softmax)."""
+    if logits is not None:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(preds, _EPS, 1.0))
+    return -_sum_features(labels * logp)
+
+
+def negativeloglikelihood(labels, preds, logits=None):
+    # DL4J's NLL is MCXENT (it subclasses LossMCXENT with clipping).
+    return mcxent(labels, preds, logits)
+
+
+def binary_xent(labels, preds, logits=None):
+    """XENT — sigmoid binary cross entropy (``LossBinaryXENT``)."""
+    if logits is not None:
+        # stable: max(z,0) - z*y + log(1+exp(-|z|))
+        z = logits
+        per = jnp.maximum(z, 0.0) - z * labels + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        return _sum_features(per)
+    p = jnp.clip(preds, _EPS, 1.0 - _EPS)
+    return -_sum_features(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p))
+
+
+def _n_features(x):
+    n = 1
+    for s in x.shape[1:]:
+        n *= s
+    return n
+
+
+def mse(labels, preds, logits=None):
+    # DL4J LossMSE divides by the output count (LossL2 is the plain sum).
+    return _sum_features(jnp.square(preds - labels)) / _n_features(labels)
+
+
+def l1(labels, preds, logits=None):
+    return _sum_features(jnp.abs(preds - labels))
+
+
+def l2(labels, preds, logits=None):
+    # DL4J LossL2 = sum of squares (MSE without the /n over outputs; in our
+    # convention both sum over features, matching DL4J's per-output sums).
+    return _sum_features(jnp.square(preds - labels))
+
+
+def hinge(labels, preds, logits=None):
+    # labels in {-1, +1} per DL4J LossHinge
+    return _sum_features(jnp.maximum(0.0, 1.0 - labels * preds))
+
+
+def squared_hinge(labels, preds, logits=None):
+    return _sum_features(jnp.square(jnp.maximum(0.0, 1.0 - labels * preds)))
+
+
+def kld(labels, preds, logits=None):
+    y = jnp.clip(labels, _EPS, 1.0)
+    p = jnp.clip(preds, _EPS, 1.0)
+    return _sum_features(y * (jnp.log(y) - jnp.log(p)))
+
+
+def poisson(labels, preds, logits=None):
+    p = jnp.clip(preds, _EPS, None)
+    return _sum_features(p - labels * jnp.log(p))
+
+
+def cosine_proximity(labels, preds, logits=None):
+    yn = labels / (jnp.linalg.norm(labels, axis=-1, keepdims=True) + _EPS)
+    pn = preds / (jnp.linalg.norm(preds, axis=-1, keepdims=True) + _EPS)
+    return -_sum_features(yn * pn)
+
+
+def mape(labels, preds, logits=None):
+    return _sum_features(
+        100.0 * jnp.abs((labels - preds) / jnp.clip(jnp.abs(labels), _EPS, None))
+    )
+
+
+def msle(labels, preds, logits=None):
+    return _sum_features(
+        jnp.square(jnp.log1p(jnp.clip(preds, -1 + _EPS, None))
+                   - jnp.log1p(jnp.clip(labels, -1 + _EPS, None)))
+    )
+
+
+def sparse_mcxent(labels, preds, logits=None):
+    """SPARSE_MCXENT — integer class labels of shape [batch]."""
+    if logits is not None:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(preds, _EPS, 1.0))
+    labels = labels.astype(jnp.int32)
+    if labels.ndim == logp.ndim:  # [batch,1]
+        labels = labels.reshape(labels.shape[:-1])
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+
+
+LOSSES = {
+    "mcxent": mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "sparse_mcxent": sparse_mcxent,
+    "xent": binary_xent,
+    "mse": mse,
+    "squared_loss": mse,
+    "l1": l1,
+    "mae": l1,
+    "l2": l2,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "kl_divergence": kld,
+    "reconstruction_crossentropy": binary_xent,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "mean_absolute_percentage_error": mape,
+    "mean_squared_logarithmic_error": msle,
+}
+
+# Losses that can consume raw logits when fused with these final activations.
+FUSED_ACTIVATIONS = {
+    "mcxent": "softmax",
+    "negativeloglikelihood": "softmax",
+    "sparse_mcxent": "softmax",
+    "xent": "sigmoid",
+}
+
+
+def get_loss(name: str):
+    fn = LOSSES.get(str(name).lower())
+    if fn is None:
+        raise ValueError(f"Unknown loss {name!r}; available: {sorted(LOSSES)}")
+    return fn

@@ -1,0 +1,107 @@
+"""Weight initialization schemes.
+
+Parity with DL4J's ``WeightInit`` enum + ``WeightInitUtil`` (reference:
+``deeplearning4j-nn org.deeplearning4j.nn.weights.WeightInit`` /
+``WeightInitUtil.initWeights``).  DL4J semantics preserved where they are
+load-bearing for loss-curve parity:
+
+* XAVIER        — N(0, 2/(fanIn+fanOut))        (DL4J's Glorot-normal)
+* XAVIER_UNIFORM— U(±sqrt(6/(fanIn+fanOut)))
+* RELU          — N(0, 2/fanIn)                  (He)
+* RELU_UNIFORM  — U(±sqrt(6/fanIn))
+* LECUN_NORMAL  — N(0, 1/fanIn)
+* SIGMOID_UNIFORM — U(±4*sqrt(6/(fanIn+fanOut)))
+* NORMAL        — N(0, 1/sqrt(fanIn))  (DL4J "NORMAL" is fan-in scaled)
+* UNIFORM       — U(±1/sqrt(fanIn))    (legacy DL4J default)
+* ZERO / ONES / IDENTITY / DISTRIBUTION(custom)
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def init_weights(
+    key,
+    shape,
+    fan_in: float,
+    fan_out: float,
+    scheme: str = "xavier",
+    dtype=jnp.float32,
+    distribution=None,
+):
+    """Sample a weight tensor per DL4J ``WeightInitUtil.initWeights``.
+
+    `shape` is the full kernel shape; fan_in/fan_out are computed by the
+    layer (for conv: fan_in = C_in * kH * kW, matching DL4J).
+    """
+    s = str(scheme).lower() if scheme is not None else "xavier"
+    if s == "zero":
+        return jnp.zeros(shape, dtype)
+    if s == "ones":
+        return jnp.ones(shape, dtype)
+    if s == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if s == "distribution":
+        if distribution is None:
+            raise ValueError("DISTRIBUTION init requires a `distribution` spec")
+        return _sample_distribution(key, shape, distribution, dtype)
+
+    # Lazy samplers: only the one the scheme needs is executed.
+    def normal():
+        return jax.random.normal(key, shape, dtype)
+
+    def uniform():
+        return jax.random.uniform(key, shape, dtype, -1.0, 1.0)
+
+    if s == "xavier":
+        return normal() * math.sqrt(2.0 / (fan_in + fan_out))
+    if s == "xavier_uniform":
+        return uniform() * math.sqrt(6.0 / (fan_in + fan_out))
+    if s == "xavier_fan_in":
+        return normal() / math.sqrt(fan_in)
+    if s == "relu":
+        return normal() * math.sqrt(2.0 / fan_in)
+    if s == "relu_uniform":
+        return uniform() * math.sqrt(6.0 / fan_in)
+    if s == "lecun_normal":
+        return normal() / math.sqrt(fan_in)
+    if s == "lecun_uniform":
+        return uniform() * math.sqrt(3.0 / fan_in)
+    if s == "sigmoid_uniform":
+        return uniform() * 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+    if s == "normal":
+        return normal() / math.sqrt(fan_in)
+    if s == "uniform":
+        a = 1.0 / math.sqrt(fan_in)
+        return uniform() * a
+    if s == "var_scaling_normal_fan_avg":
+        return normal() * math.sqrt(2.0 / (fan_in + fan_out))
+    raise ValueError(f"Unknown weight init scheme {scheme!r}")
+
+
+def _sample_distribution(key, shape, dist, dtype):
+    """`dist` is a dict like {"type": "normal", "mean": 0, "std": 1e-2} —
+    the analogue of DL4J ``org.deeplearning4j.nn.conf.distribution.*``."""
+    t = dist.get("type", "normal").lower()
+    if t == "normal" or t == "gaussian":
+        return dist.get("mean", 0.0) + jax.random.normal(key, shape, dtype) * dist.get(
+            "std", 1.0
+        )
+    if t == "uniform":
+        return jax.random.uniform(
+            key, shape, dtype, dist.get("lower", -1.0), dist.get("upper", 1.0)
+        )
+    if t == "truncated_normal":
+        return dist.get("mean", 0.0) + jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, dtype
+        ) * dist.get("std", 1.0)
+    if t == "orthogonal":
+        return dist.get("gain", 1.0) * jax.random.orthogonal(key, shape[0], shape=()) \
+            if len(shape) == 1 else dist.get("gain", 1.0) * jax.random.orthogonal(
+                key, max(shape), shape=())[: shape[0], : shape[1]].astype(dtype)
+    raise ValueError(f"Unknown distribution type {t!r}")

@@ -1,0 +1,20 @@
+"""Optimization: updaters, LR schedules, the solver (train-step assembly),
+and the training-listener bus.
+
+TPU-native twin of ``org.deeplearning4j.optimize`` + the updater math in
+``org.nd4j.linalg.learning``.  DL4J applies updaters in-place on one
+flattened parameter vector through ``UpdaterBlock`` views; here updaters are
+pure pytree transforms fused by XLA into the compiled train step.
+"""
+
+from deeplearning4j_tpu.optimize.updaters import (
+    Adam, AdamW, AdaDelta, AdaGrad, AdaMax, AMSGrad, Nadam, Nesterovs, NoOp,
+    RmsProp, Sgd, updater_from_dict,
+)
+from deeplearning4j_tpu.optimize.schedules import schedule_from_spec
+
+__all__ = [
+    "Sgd", "Adam", "AdamW", "AdaMax", "Nesterovs", "RmsProp", "AdaGrad",
+    "AdaDelta", "AMSGrad", "Nadam", "NoOp", "updater_from_dict",
+    "schedule_from_spec",
+]

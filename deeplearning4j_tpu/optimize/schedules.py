@@ -1,0 +1,77 @@
+"""Learning-rate schedules.
+
+Parity with ND4J's ``ISchedule`` family (reference:
+``org.nd4j.linalg.schedule.{ExponentialSchedule,InverseSchedule,MapSchedule,
+PolySchedule,RampSchedule,SigmoidSchedule,StepSchedule,CycleSchedule}``).
+A schedule is a pure fn(step) -> lr so it traces into the compiled step
+(step is a traced scalar; all branches are jnp math, no Python control
+flow on step).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def schedule_from_spec(spec):
+    """spec: float (fixed) or dict {"type": ..., ...} -> fn(step)->lr.
+
+    Schedules are stepped per ITERATION (DL4J ScheduleType.ITERATION); for
+    epoch-based scheduling pass iterations_per_epoch when building the dict.
+    """
+    if spec is None:
+        return lambda step: 0.0
+    if isinstance(spec, (int, float)):
+        v = float(spec)
+        return lambda step: v
+    t = str(spec.get("type", "fixed")).lower()
+    if t == "fixed":
+        v = float(spec["value"])
+        return lambda step: v
+
+    lr = float(spec.get("initial", spec.get("value", 0.1)))
+    if t == "exponential":
+        gamma = float(spec.get("gamma", 0.99))
+        return lambda step: lr * jnp.power(gamma, step)
+    if t == "inverse":
+        gamma, power = float(spec.get("gamma", 0.99)), float(spec.get("power", 1.0))
+        return lambda step: lr / jnp.power(1.0 + gamma * step, power)
+    if t == "poly":
+        power, max_iter = float(spec.get("power", 1.0)), float(spec["max_iter"])
+        return lambda step: lr * jnp.power(
+            1.0 - jnp.minimum(step, max_iter) / max_iter, power)
+    if t == "step":
+        decay, step_size = float(spec.get("decay", 0.1)), float(spec["step"])
+        return lambda step: lr * jnp.power(decay, jnp.floor(step / step_size))
+    if t == "sigmoid":
+        gamma, step_size = float(spec.get("gamma", 0.99)), float(spec["step"])
+        return lambda step: lr / (1.0 + jnp.exp(-gamma * (step - step_size)))
+    if t == "ramp":  # warmup to lr over `warmup` steps, then constant
+        warmup = float(spec.get("warmup", 1000))
+        return lambda step: lr * jnp.minimum(1.0, (step + 1) / warmup)
+    if t == "warmup_cosine":  # TPU-era staple (not in DL4J): linear warmup + cosine
+        warmup = float(spec.get("warmup", 1000))
+        total = float(spec["max_iter"])
+        def fn(step):
+            warm = lr * jnp.minimum(1.0, (step + 1) / warmup)
+            prog = jnp.clip((step - warmup) / jnp.maximum(total - warmup, 1), 0, 1)
+            return jnp.where(step < warmup, warm,
+                             0.5 * lr * (1 + jnp.cos(jnp.pi * prog)))
+        return fn
+    if t == "cycle":
+        cycle_len = float(spec["cycle_length"])
+        max_lr = float(spec.get("max", lr * 10))
+        def fn(step):
+            pos = (step % cycle_len) / cycle_len
+            tri = 1.0 - jnp.abs(2.0 * pos - 1.0)
+            return lr + (max_lr - lr) * tri
+        return fn
+    if t == "map":
+        # {"type":"map","values":{"0":0.1,"1000":0.01}} — piecewise constant
+        points = sorted((int(k), float(v)) for k, v in spec["values"].items())
+        def fn(step):
+            out = jnp.asarray(points[0][1])
+            for s, v in points:
+                out = jnp.where(step >= s, v, out)
+            return out
+        return fn
+    raise ValueError(f"Unknown schedule type {t!r}")

@@ -1,0 +1,119 @@
+"""Solver: assembles the single compiled train step.
+
+Replaces DL4J's ``Solver`` → ``StochasticGradientDescent`` →
+``BaseOptimizer`` chain (reference: ``org.deeplearning4j.optimize.solvers.
+{Solver,StochasticGradientDescent,BaseOptimizer}``).  Where DL4J runs
+``computeGradientAndScore`` (thousands of eager ops, one JNI crossing each)
+then applies the updater in-place, here the WHOLE iteration — forward, loss,
+backward (jax.grad), gradient normalization, updater math, parameter
+update — is one XLA program.  Parameter and optimizer-state buffers are
+donated, so the update is in-place in HBM (the workspace behavior DL4J got
+from flattened-vector views).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.optimize.updaters import BaseUpdater
+
+
+def normalize_gradients(grads, kind: Optional[str], threshold: float):
+    """DL4J ``GradientNormalization`` semantics
+    (``org.deeplearning4j.nn.conf.GradientNormalization``)."""
+    if not kind or kind == "none":
+        return grads
+    if kind == "clip_element_wise_absolute_value":
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -threshold, threshold), grads)
+    if kind == "clip_l2_per_layer":
+        def clip(g):
+            n = jnp.linalg.norm(g.reshape(-1))
+            return g * jnp.minimum(1.0, threshold / (n + 1e-12))
+        return jax.tree_util.tree_map(clip, grads)
+    if kind == "renormalize_l2_per_layer":
+        return jax.tree_util.tree_map(
+            lambda g: g / (jnp.linalg.norm(g.reshape(-1)) + 1e-12), grads)
+    if kind == "clip_l2_per_param_type":
+        # DL4J ClipL2PerParamType: one clip per parameter TYPE (all the
+        # W's together, all the b's together, ...) across layers.
+        leaves_with_path = jax.tree_util.tree_leaves_with_path(grads)
+        norms = {}
+        for path, leaf in leaves_with_path:
+            ptype = str(path[-1])
+            norms[ptype] = norms.get(ptype, 0.0) + jnp.sum(jnp.square(leaf))
+
+        def clip_by_type(path, g):
+            n = jnp.sqrt(norms[str(path[-1])])
+            return g * jnp.minimum(1.0, threshold / (n + 1e-12))
+
+        return jax.tree_util.tree_map_with_path(clip_by_type, grads)
+    if kind == "clip_global_norm":
+        leaves = jax.tree_util.tree_leaves(grads)
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        scale = jnp.minimum(1.0, threshold / (gn + 1e-12))
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    raise ValueError(f"Unknown gradient normalization {kind!r}")
+
+
+class Solver:
+    """Owns the compiled step for one model.
+
+    `score_fn(params, model_state, batch, rng, training) ->
+    (loss, new_model_state)` is supplied by the network class; `batch` is a
+    dict with 'features', 'labels', optional masks.
+    """
+
+    def __init__(
+        self,
+        score_fn: Callable,
+        updater: BaseUpdater,
+        grad_normalization: Optional[str] = None,
+        grad_norm_threshold: float = 1.0,
+        minimize: bool = True,
+        decay_tree=None,
+    ):
+        self.score_fn = score_fn
+        self.updater = updater
+        self.grad_normalization = grad_normalization
+        self.grad_norm_threshold = grad_norm_threshold
+        self.minimize = minimize
+        # decay_tree: pytree of per-leaf weight-decay coefficients matching
+        # the params structure (0.0 = no decay).  Applied DECOUPLED
+        # (update += lr*wd*param), matching DL4J's WeightDecay
+        # regularization (applyLR=true default), distinct from l2 which
+        # contributes to the loss.
+        self.decay_tree = decay_tree
+        self._step = jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
+
+    def init_opt_state(self, params):
+        return self.updater.init_state(params)
+
+    def _step_impl(self, params, opt_state, model_state, step_idx, batch, rng):
+        def loss_of(p):
+            loss, new_state = self.score_fn(p, model_state, batch, rng, True)
+            return (loss if self.minimize else -loss), new_state
+
+        (loss, new_model_state), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        if not self.minimize:
+            loss = -loss  # report the true (maximized) score, not -score
+        grads = normalize_gradients(
+            grads, self.grad_normalization, self.grad_norm_threshold)
+        updates, opt_state = self.updater.update(grads, opt_state, params, step_idx)
+        if self.decay_tree is not None:
+            lr = self.updater.lr_at(step_idx)
+            updates = jax.tree_util.tree_map(
+                lambda u, p, wd: u + lr * wd * p, updates, params,
+                self.decay_tree)
+        params = jax.tree_util.tree_map(lambda p, u: p - u, params, updates)
+        return params, opt_state, new_model_state, loss
+
+    def step(self, params, opt_state, model_state, step_idx, batch, rng):
+        """One optimization iteration; returns (params, opt_state,
+        model_state, loss).  Donated inputs must not be reused by caller."""
+        return self._step(params, opt_state, model_state,
+                          jnp.asarray(step_idx, jnp.int32), batch, rng)

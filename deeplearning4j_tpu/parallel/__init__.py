@@ -1,0 +1,37 @@
+"""Parallel training over device meshes.
+
+TPU-native replacement for the ENTIRE reference scale-out stack
+(SURVEY.md §2.3): ``ParallelWrapper`` (single-node data parallel),
+``SharedTrainingMaster``/Spark (multi-node data parallel),
+``ModelParameterServer``/Aeron transport (gradient plane), and the
+threshold-encoding gradient compression.  All of it collapses into ONE
+code path: a ``jax.sharding.Mesh`` + ``NamedSharding`` annotations on a
+single jitted train step — XLA inserts the all-reduce (ICI within a slice,
+DCN across slices), and ``jax.distributed.initialize`` is the control
+plane that replaces Spark + Aeron handshakes.
+
+Mesh axes: ``data`` (DP), ``model`` (TP), ``pipeline`` (PP), ``sequence``
+(SP/ring-attention context parallelism) — the latter two are new
+capabilities the reference lacks (SURVEY.md §2.3 marks TP/PP/SP absent).
+"""
+
+from deeplearning4j_tpu.parallel.mesh import MeshConfig
+from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+
+__all__ = ["MeshConfig", "ShardedTrainer", "initialize_distributed"]
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None):
+    """Multi-host control plane (replaces Spark driver + Aeron mesh
+    handshake): a thin wrapper over ``jax.distributed.initialize`` so the
+    same sharded train step spans hosts over DCN."""
+    import jax
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)

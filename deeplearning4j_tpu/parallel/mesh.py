@@ -1,0 +1,61 @@
+"""Device-mesh configuration.
+
+The single abstraction that replaces the reference's three distinct
+distribution mechanisms (ParallelWrapper thread pool, Spark RDD
+partitioning, Aeron UDP mesh topology / ``MeshOrganizer`` spanning tree):
+a logical mesh over physical chips, with named axes that sharding specs
+refer to.  ICI topology mapping is delegated to
+``jax.experimental.mesh_utils`` which lays axes onto the torus optimally.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape.  Product must divide the available device count
+    (remaining devices are left unused).  Axis names are canonical:
+    'data' (DP), 'model' (TP), 'pipeline' (PP), 'sequence' (SP)."""
+
+    data: int = 1
+    model: int = 1
+    pipeline: int = 1
+    sequence: int = 1
+
+    def axis_sizes(self) -> Tuple[Tuple[str, int], ...]:
+        return (("data", self.data), ("model", self.model),
+                ("pipeline", self.pipeline), ("sequence", self.sequence))
+
+    def total(self) -> int:
+        return self.data * self.model * self.pipeline * self.sequence
+
+    def build(self, devices=None) -> Mesh:
+        devices = list(devices) if devices is not None else jax.devices()
+        n = self.total()
+        if n > len(devices):
+            raise ValueError(
+                f"Mesh needs {n} devices, only {len(devices)} available")
+        # Keep only axes of size > 1 plus 'data' (so at least one axis).
+        names = [name for name, size in self.axis_sizes() if size > 1]
+        sizes = [size for _, size in self.axis_sizes() if size > 1]
+        if not names:
+            names, sizes = ["data"], [1]
+        try:
+            from jax.experimental import mesh_utils
+            dev_array = mesh_utils.create_device_mesh(
+                tuple(sizes), devices=devices[:n])
+        except Exception:
+            dev_array = np.asarray(devices[:n]).reshape(tuple(sizes))
+        return Mesh(dev_array, tuple(names))
+
+    @staticmethod
+    def data_parallel(n_devices: Optional[int] = None) -> "MeshConfig":
+        """All chips on the data axis — the ParallelWrapper /
+        SharedTrainingMaster equivalent."""
+        return MeshConfig(data=n_devices or len(jax.devices()))

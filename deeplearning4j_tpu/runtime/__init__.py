@@ -1,0 +1,14 @@
+"""Runtime substrate: device/backend discovery, dtypes, RNG, profiling.
+
+TPU-native replacement for the ND4J runtime layer (reference:
+``nd4j/nd4j-backends/nd4j-api-parent/nd4j-api`` — ``Nd4jBackend`` SPI,
+``DataBuffer`` dtypes, ``Nd4j.getRandom``).  Buffers, allocators, streams and
+workspaces from libnd4j are all owned by PJRT/XLA here; what remains is
+policy: which platform, which dtypes, how randomness is keyed.
+"""
+
+from deeplearning4j_tpu.runtime.backend import Backend, backend
+from deeplearning4j_tpu.runtime.dtype import DataType, canonical_dtype
+from deeplearning4j_tpu.runtime.rng import RngKeyManager
+
+__all__ = ["Backend", "backend", "DataType", "canonical_dtype", "RngKeyManager"]

@@ -1,0 +1,60 @@
+"""Backend discovery and policy.
+
+Replaces the ``Nd4jBackend`` ServiceLoader SPI (reference:
+``nd4j-api org.nd4j.linalg.factory.Nd4jBackend``; CPU/CUDA backends in
+``nd4j/nd4j-backends/nd4j-backend-impls/{nd4j-native,nd4j-cuda}``).  On TPU
+the backend seam is PJRT: jax discovers platforms (tpu/cpu) and every op in
+this framework lowers through XLA, so "selecting a backend" reduces to
+choosing a platform, a default compute dtype, and donation policy.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Backend:
+    """Resolved execution environment.
+
+    Mirrors what ``Nd4jBackend`` + ``Nd4jEnvironment`` expose to user code:
+    platform identity, device inventory, default dtypes.
+    """
+
+    platform: str
+    n_devices: int
+    # Params are kept in `param_dtype`; matmul/conv compute runs in
+    # `compute_dtype` (bf16 feeds the MXU at full rate on TPU).
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def devices(self):
+        return jax.devices()
+
+    @property
+    def is_tpu(self) -> bool:
+        return self.platform in ("tpu", "axon")
+
+    def local_device_count(self) -> int:
+        return jax.local_device_count()
+
+
+@lru_cache(maxsize=None)
+def backend() -> Backend:
+    """Discover the active backend once per process.
+
+    ``DL4J_TPU_COMPUTE_DTYPE=bfloat16`` switches matmul/conv compute to
+    bf16 (the TPU-native default for training at speed); params stay f32.
+    Analogue of ND4J's ``ND4J_*`` env-var runtime knobs
+    (``org.nd4j.linalg.factory.Nd4jEnvironment``).
+    """
+    devs = jax.devices()
+    platform = devs[0].platform
+    compute = os.environ.get("DL4J_TPU_COMPUTE_DTYPE", "")
+    compute_dtype = jnp.bfloat16 if compute in ("bfloat16", "bf16") else jnp.float32
+    return Backend(platform=platform, n_devices=len(devs), compute_dtype=compute_dtype)

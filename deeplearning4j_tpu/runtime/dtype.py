@@ -1,0 +1,47 @@
+"""Data types.
+
+Mirrors ND4J's dtype zoo (reference: ``org.nd4j.linalg.api.buffer.DataType``:
+DOUBLE/FLOAT/HALF/BFLOAT16/LONG/INT/SHORT/BYTE/UBYTE/BOOL/UTF8 plus
+quantized).  On TPU the natives are f32/bf16/s32/s8; f64 exists but is slow
+and only used by the gradient-check harness.
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    DOUBLE = "float64"
+    FLOAT = "float32"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    LONG = "int64"
+    INT = "int32"
+    SHORT = "int16"
+    BYTE = "int8"
+    UBYTE = "uint8"
+    BOOL = "bool"
+
+    @property
+    def jnp(self) -> jnp.dtype:
+        return jnp.dtype(self.value)
+
+    @classmethod
+    def from_any(cls, d) -> "DataType":
+        if isinstance(d, DataType):
+            return d
+        name = np.dtype(d).name if not isinstance(d, str) else d
+        for m in cls:
+            if m.value == name or m.name == str(name).upper():
+                return m
+        raise ValueError(f"Unsupported dtype: {d!r}")
+
+
+def canonical_dtype(d) -> jnp.dtype:
+    """Coerce any dtype spec (DataType | str | np/jnp dtype) to a jnp dtype."""
+    if isinstance(d, DataType):
+        return d.jnp
+    return jnp.dtype(d)

@@ -1,0 +1,44 @@
+"""Random-number management.
+
+Replaces ND4J's global Random (reference: ``Nd4j.getRandom`` backed by
+libnd4j's Philox counter RNG, ``libnd4j/include/helpers/RandomLauncher.h``).
+jax's threefry is the same counter-based design; the difference is explicit
+functional keying.  This manager provides the DL4J-style "seed once,
+consume forever" ergonomics on top of split keys, so model code never
+reuses a key.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class RngKeyManager:
+    """Stateful facade over functional jax PRNG keys.
+
+    ``next_key()`` is the analogue of each ``Nd4j.getRandom().nextGaussian``
+    consumption site: every call returns a fresh, never-reused key.  Thread
+    safe, since DL4J allowed concurrent fit threads (ParallelWrapper).
+    """
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+        self._lock = threading.Lock()
+        self.seed = seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def next_keys(self, n: int):
+        with self._lock:
+            keys = jax.random.split(self._key, n + 1)
+            self._key = keys[0]
+            return keys[1:]
+
+    def reset(self, seed: int):
+        with self._lock:
+            self._key = jax.random.key(seed)
+            self.seed = seed

@@ -1,0 +1,1 @@
+"""Utilities: model serialization, pytree helpers."""

@@ -1,0 +1,139 @@
+"""Model checkpointing.
+
+Parity with ``org.deeplearning4j.util.ModelSerializer``: a checkpoint is a
+single zip containing ``configuration.json`` (the declarative model IR),
+``coefficients.npz`` (parameter pytree), ``state.npz`` (batchnorm running
+stats etc.), and optionally ``updaterState.npz`` + ``training.json``
+(iteration/epoch counters) so training resumes EXACTLY — the same resume
+guarantee DL4J's zip (configuration.json + coefficients.bin +
+updaterState.bin) provides.
+
+Arrays are stored as host numpy inside the zip (works for any pytree of
+jax Arrays); for sharded multi-host checkpoints use
+``deeplearning4j_tpu.parallel`` + orbax instead.
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_CONFIG = "configuration.json"
+_PARAMS = "coefficients.npz"
+_STATE = "state.npz"
+_UPDATER = "updaterState.npz"
+_TRAINING = "training.json"
+
+
+def _flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:
+    out = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree.keys()):
+            out.update(_flatten_tree(tree[k], f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten_tree(v, f"{prefix}#{i}/"))
+    elif tree is None:
+        pass
+    else:
+        key = prefix[:-1] if prefix.endswith("/") else prefix
+        out[key] = np.asarray(tree)
+    return out
+
+
+def _unflatten_tree(flat: Dict[str, np.ndarray]):
+    root: Dict[str, Any] = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(val)
+
+    def listify(node):
+        if isinstance(node, dict):
+            if node and all(k.startswith("#") for k in node):
+                return [listify(node[f"#{i}"]) for i in range(len(node))]
+            return {k: listify(v) for k, v in node.items()}
+        return node
+
+    return listify(root)
+
+
+def _npz_bytes(tree) -> bytes:
+    buf = io.BytesIO()
+    flat = _flatten_tree(tree)
+    np.savez(buf, **flat) if flat else np.savez(buf, __empty__=np.zeros(0))
+    return buf.getvalue()
+
+
+def _tree_from_npz(data: bytes):
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        flat = {k: z[k] for k in z.files if k != "__empty__"}
+    return _unflatten_tree(flat)
+
+
+def write_model(model, path, save_updater: bool = True) -> None:
+    """DL4J ``ModelSerializer.writeModel(model, file, saveUpdater)``."""
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr(_CONFIG, json.dumps(model.conf.to_dict(), indent=2))
+        zf.writestr(_PARAMS, _npz_bytes(model.params_tree or {}))
+        zf.writestr(_STATE, _npz_bytes(model.state_tree or {}))
+        if save_updater and model.opt_state is not None:
+            zf.writestr(_UPDATER, _npz_bytes(model.opt_state))
+        zf.writestr(_TRAINING, json.dumps({
+            "iteration_count": model.iteration_count,
+            "epoch_count": model.epoch_count,
+        }))
+
+
+def restore_multi_layer_network(path, load_updater: bool = True):
+    """DL4J ``ModelSerializer.restoreMultiLayerNetwork``."""
+    from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+
+    with zipfile.ZipFile(path, "r") as zf:
+        conf = MultiLayerConfiguration.from_dict(
+            json.loads(zf.read(_CONFIG).decode()))
+        model = MultiLayerNetwork(conf)
+        model.params_tree = _tree_from_npz(zf.read(_PARAMS))
+        model.state_tree = _tree_from_npz(zf.read(_STATE))
+        # empty layer states must exist for every layer
+        for i in range(len(model.layers)):
+            model.state_tree.setdefault(f"layer_{i}", {})
+            model.params_tree.setdefault(f"layer_{i}", {})
+        if load_updater and _UPDATER in zf.namelist():
+            model.opt_state = _tree_from_npz(zf.read(_UPDATER))
+        if _TRAINING in zf.namelist():
+            t = json.loads(zf.read(_TRAINING).decode())
+            model.iteration_count = t.get("iteration_count", 0)
+            model.epoch_count = t.get("epoch_count", 0)
+    return model
+
+
+def restore_computation_graph(path, load_updater: bool = True):
+    """DL4J ``ModelSerializer.restoreComputationGraph``."""
+    from deeplearning4j_tpu.models.computation_graph import (
+        ComputationGraph, ComputationGraphConfiguration)
+
+    with zipfile.ZipFile(path, "r") as zf:
+        conf = ComputationGraphConfiguration.from_dict(
+            json.loads(zf.read(_CONFIG).decode()))
+        model = ComputationGraph(conf)
+        model.params_tree = _tree_from_npz(zf.read(_PARAMS))
+        model.state_tree = _tree_from_npz(zf.read(_STATE))
+        for name in model.vertex_names():
+            model.state_tree.setdefault(name, {})
+            model.params_tree.setdefault(name, {})
+        if load_updater and _UPDATER in zf.namelist():
+            model.opt_state = _tree_from_npz(zf.read(_UPDATER))
+        if _TRAINING in zf.namelist():
+            t = json.loads(zf.read(_TRAINING).decode())
+            model.iteration_count = t.get("iteration_count", 0)
+            model.epoch_count = t.get("epoch_count", 0)
+    return model

@@ -1,0 +1,27 @@
+"""Test environment: force an 8-device virtual CPU platform so
+multi-device sharding tests run real XLA collectives without TPU
+hardware — the analogue of DL4J's loopback-Aeron / Spark-local[N]
+distributed tests (SURVEY.md §4).
+
+Note: this image's axon sitecustomize registers the TPU plugin at
+interpreter startup and pins JAX_PLATFORMS=axon, so plain env vars are not
+enough — we must override via jax.config before any backend initializes.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

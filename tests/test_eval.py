@@ -1,0 +1,83 @@
+"""Evaluation metrics vs hand-computed values.
+
+Mirrors ``nd4j .../evaluation/EvaluationTest``, ``ROCTest``,
+``RegressionEvalTest``.
+"""
+import numpy as np
+
+from deeplearning4j_tpu.eval import (Evaluation, EvaluationBinary,
+                                     RegressionEvaluation, ROC)
+
+
+def test_evaluation_confusion_and_accuracy():
+    ev = Evaluation()
+    labels = np.eye(3)[[0, 0, 1, 1, 2, 2]]
+    preds = np.eye(3)[[0, 1, 1, 1, 2, 0]]  # 4/6 correct
+    ev.eval(labels, preds + 0.01)
+    assert abs(ev.accuracy() - 4 / 6) < 1e-9
+    assert ev.confusion[0, 1] == 1 and ev.confusion[2, 0] == 1
+    assert ev.confusion[1, 1] == 2
+
+
+def test_evaluation_streaming_merge_equivalence():
+    rng = np.random.default_rng(0)
+    labels = np.eye(4)[rng.integers(0, 4, 100)]
+    preds = rng.random((100, 4))
+    full = Evaluation()
+    full.eval(labels, preds)
+    a, b = Evaluation(), Evaluation()
+    a.eval(labels[:50], preds[:50])
+    b.eval(labels[50:], preds[50:])
+    a.merge(b)
+    assert np.array_equal(a.confusion, full.confusion)
+
+
+def test_precision_recall_f1_binary_case():
+    ev = Evaluation()
+    # class1: tp=2 fp=1 fn=1
+    labels = np.eye(2)[[1, 1, 1, 0, 0]]
+    preds = np.eye(2)[[1, 1, 0, 1, 0]]
+    ev.eval(labels, preds + 1e-3)
+    assert abs(ev.precision(1) - 2 / 3) < 1e-9
+    assert abs(ev.recall(1) - 2 / 3) < 1e-9
+
+
+def test_roc_auc_perfect_and_random():
+    roc = ROC()
+    labels = np.array([1, 1, 1, 0, 0, 0])
+    perfect = np.array([0.9, 0.8, 0.7, 0.3, 0.2, 0.1])
+    roc.eval(labels, perfect)
+    assert abs(roc.calculate_auc() - 1.0) < 1e-9
+    roc2 = ROC()
+    roc2.eval(labels, 1 - perfect)
+    assert roc2.calculate_auc() < 0.01
+
+
+def test_roc_histogram_mode_approximates_exact():
+    rng = np.random.default_rng(3)
+    labels = rng.integers(0, 2, 3000)
+    scores = np.clip(labels * 0.3 + rng.normal(0.35, 0.25, 3000), 0, 1)
+    exact, hist = ROC(exact=True), ROC(exact=False, n_bins=200)
+    exact.eval(labels, scores)
+    hist.eval(labels, scores)
+    assert abs(exact.calculate_auc() - hist.calculate_auc()) < 0.02
+
+
+def test_regression_eval_r2_and_mse():
+    ev = RegressionEvaluation()
+    labels = np.array([[1.0], [2.0], [3.0], [4.0]])
+    preds = np.array([[1.1], [1.9], [3.2], [3.8]])
+    ev.eval(labels, preds)
+    expect_mse = np.mean((preds - labels) ** 2)
+    assert abs(ev.mean_squared_error(0) - expect_mse) < 1e-9
+    assert ev.r_squared(0) > 0.95
+    assert ev.pearson_correlation(0) > 0.99
+
+
+def test_evaluation_binary_per_output():
+    ev = EvaluationBinary()
+    labels = np.array([[1, 0], [1, 1], [0, 0], [0, 1]])
+    preds = np.array([[0.9, 0.1], [0.8, 0.4], [0.3, 0.2], [0.1, 0.9]])
+    ev.eval(labels, preds)
+    assert ev.accuracy(0) == 1.0
+    assert abs(ev.recall(1) - 0.5) < 1e-9

@@ -1,0 +1,116 @@
+"""Core layer semantics + config JSON round-trip.
+
+Mirrors DL4J's layer config/serde tests
+(``deeplearning4j-core .../nn/conf/MultiLayerNeuralNetConfigurationTest``)
+and dense-layer activation tests.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.activations import ACTIVATIONS, get_activation
+from deeplearning4j_tpu.nn.conf.builder import (MultiLayerConfiguration,
+                                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer, DropoutLayer,
+                                                    EmbeddingLayer,
+                                                    OutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+
+def _mlp_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(Adam(learning_rate=1e-3))
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_in=10, n_out=20, activation="relu"))
+            .layer(DenseLayer(n_out=15, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+
+
+def test_builder_infers_n_in():
+    conf = _mlp_conf()
+    assert conf.layers[1].n_in == 20
+    assert conf.layers[2].n_in == 15
+
+
+def test_json_roundtrip():
+    conf = _mlp_conf()
+    j = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(j)
+    assert conf2.to_json() == j
+    assert isinstance(conf2.layers[0], DenseLayer)
+    assert conf2.layers[0].n_out == 20
+    assert conf2.global_conf.seed == 12345
+    assert conf2.global_conf.updater["type"] == "Adam"
+
+
+def test_dense_forward_matches_numpy():
+    ly = DenseLayer(n_in=4, n_out=3, activation="relu", weight_init="xavier")
+    params, state = ly.init(jax.random.key(0))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(5, 4)),
+                    jnp.float32)
+    y, _ = ly.apply(params, state, x, training=False)
+    expect = np.maximum(np.asarray(x) @ np.asarray(params["W"])
+                        + np.asarray(params["b"]), 0)
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5)
+
+
+def test_dense_handles_sequence_input():
+    ly = DenseLayer(n_in=4, n_out=3, activation="identity")
+    params, state = ly.init(jax.random.key(0))
+    x = jnp.ones((2, 7, 4))
+    y, _ = ly.apply(params, state, x, training=False)
+    assert y.shape == (2, 7, 3)
+
+
+def test_dropout_train_vs_infer():
+    ly = DropoutLayer(rate=0.5)
+    x = jnp.ones((4, 100))
+    y_inf, _ = ly.apply({}, {}, x, training=False, rng=None)
+    np.testing.assert_array_equal(np.asarray(y_inf), np.asarray(x))
+    y_tr, _ = ly.apply({}, {}, x, training=True, rng=jax.random.key(1))
+    arr = np.asarray(y_tr)
+    assert set(np.unique(arr)).issubset({0.0, 2.0})  # inverted scaling
+    assert 0.3 < (arr == 0).mean() < 0.7
+
+
+def test_embedding_lookup():
+    ly = EmbeddingLayer(n_in=7, n_out=5)
+    params, state = ly.init(jax.random.key(0))
+    idx = jnp.asarray([[0], [3], [6]])
+    y, _ = ly.apply(params, state, idx, training=False)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(params["W"])[[0, 3, 6]])
+
+
+def test_all_activations_finite():
+    x = jnp.linspace(-3, 3, 64).reshape(4, 16)
+    for name in ACTIVATIONS:
+        y = get_activation(name)(x)
+        assert np.isfinite(np.asarray(y)).all(), name
+
+
+def test_input_type_cnn_to_ff_preprocessor():
+    conf = (NeuralNetConfiguration.builder()
+            .list()
+            .layer(DenseLayer(n_out=10, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.convolutional(8, 8, 3))
+            .build())
+    assert conf.layers[0].n_in == 8 * 8 * 3
+    assert conf.preprocessors[0] is not None
+    x = jnp.ones((2, 8, 8, 3))
+    assert conf.preprocessors[0](x).shape == (2, 192)
+
+
+def test_weight_init_statistics():
+    ly = DenseLayer(n_in=400, n_out=300, activation="identity",
+                    weight_init="xavier")
+    params, _ = ly.init(jax.random.key(7))
+    w = np.asarray(params["W"])
+    expect_std = np.sqrt(2.0 / (400 + 300))
+    assert abs(w.std() - expect_std) < 0.1 * expect_std
+    assert abs(w.mean()) < 3 * expect_std / np.sqrt(w.size)

@@ -1,0 +1,76 @@
+"""Loss semantics: fused-vs-unfused equivalence and gradient checks.
+
+The gradient-check harness role of DL4J's ``GradientCheckUtil``
+(``deeplearning4j-core org.deeplearning4j.gradientcheck``) is played by
+``jax.test_util.check_grads`` — numerical vs analytic derivatives.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.test_util import check_grads
+
+from deeplearning4j_tpu.nn.losses import (binary_xent, get_loss, mcxent, mse,
+                                          sparse_mcxent)
+
+jax.config.update("jax_enable_x64", False)
+
+
+def _softmax(z):
+    e = np.exp(z - z.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def test_mcxent_fused_equals_unfused():
+    rng = np.random.default_rng(0)
+    z = jnp.asarray(rng.normal(size=(6, 5)), jnp.float32)
+    y = jax.nn.one_hot(jnp.asarray(rng.integers(0, 5, 6)), 5)
+    fused = mcxent(y, None, logits=z)
+    unfused = mcxent(y, jax.nn.softmax(z, -1))
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(unfused),
+                               rtol=1e-5)
+
+
+def test_binary_xent_fused_equals_unfused():
+    rng = np.random.default_rng(1)
+    z = jnp.asarray(rng.normal(size=(6, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, (6, 3)), jnp.float32)
+    fused = binary_xent(y, None, logits=z)
+    unfused = binary_xent(y, jax.nn.sigmoid(z))
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(unfused),
+                               rtol=1e-4)
+
+
+def test_sparse_matches_dense_mcxent():
+    rng = np.random.default_rng(2)
+    z = jnp.asarray(rng.normal(size=(6, 5)), jnp.float32)
+    idx = jnp.asarray(rng.integers(0, 5, 6))
+    dense = mcxent(jax.nn.one_hot(idx, 5), None, logits=z)
+    sparse = sparse_mcxent(idx, None, logits=z)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(sparse),
+                               rtol=1e-5)
+
+
+def test_gradient_check_losses():
+    """Numerical-vs-analytic gradient check on every differentiable loss —
+    the GradientCheckUtil analogue at the loss level."""
+    rng = np.random.default_rng(3)
+    z = jnp.asarray(rng.normal(size=(4, 5)), jnp.float32)
+    y_onehot = jax.nn.one_hot(jnp.asarray(rng.integers(0, 5, 4)), 5)
+    y_real = jnp.asarray(rng.normal(size=(4, 5)), jnp.float32)
+
+    check_grads(lambda q: jnp.mean(mcxent(y_onehot, None, logits=q)),
+                (z,), order=1, modes=["rev"], atol=1e-2, rtol=1e-2)
+    check_grads(lambda q: jnp.mean(mse(y_real, q)), (z,), order=1,
+                modes=["rev"], atol=1e-2, rtol=1e-2)
+    check_grads(lambda q: jnp.mean(binary_xent(
+        (y_real > 0).astype(jnp.float32), None, logits=q)), (z,),
+        order=1, modes=["rev"], atol=1e-2, rtol=1e-2)
+
+
+def test_mcxent_known_value():
+    # perfect prediction -> loss ~ 0; uniform prediction -> log(C)
+    y = jnp.asarray([[1.0, 0.0, 0.0, 0.0]])
+    uniform = jnp.full((1, 4), 0.25)
+    loss_fn = get_loss("mcxent")
+    np.testing.assert_allclose(float(loss_fn(y, uniform)[0]), np.log(4),
+                               rtol=1e-5)

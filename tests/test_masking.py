@@ -1,0 +1,112 @@
+"""Label-mask scoring semantics (regression tests for the masked-loss path).
+
+DL4J reference behavior: ``BaseOutputLayer.computeScore`` with LossUtil
+masking — [b] / [b,1] masks weight whole examples; [b,t] masks weight
+individual timesteps of sequence outputs.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.layers_core import OutputLayer
+
+
+def _scores(labels, z, mask):
+    ly = OutputLayer(n_in=4, n_out=labels.shape[-1], activation="softmax",
+                     loss="mcxent")
+    return np.asarray(ly.per_example_score(jnp.asarray(labels),
+                                           jnp.asarray(z),
+                                           None if mask is None
+                                           else jnp.asarray(mask)))
+
+
+def test_example_mask_b1_zeroes_only_masked_examples():
+    rng = np.random.default_rng(0)
+    z = rng.normal(size=(3, 5)).astype(np.float32)
+    labels = np.eye(5, dtype=np.float32)[[0, 1, 2]]
+    unmasked = _scores(labels, z, None)
+    masked = _scores(labels, z, np.asarray([[0.0], [1.0], [1.0]]))
+    assert masked[0] == 0.0
+    np.testing.assert_allclose(masked[1:], unmasked[1:], rtol=1e-6)
+
+
+def test_example_mask_flat_b():
+    rng = np.random.default_rng(1)
+    z = rng.normal(size=(4, 3)).astype(np.float32)
+    labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    masked = _scores(labels, z, np.asarray([1.0, 0.0, 1.0, 0.0]))
+    unmasked = _scores(labels, z, None)
+    np.testing.assert_allclose(masked, unmasked * [1, 0, 1, 0], rtol=1e-6)
+
+
+def test_sequence_mask_bt_weights_timesteps():
+    rng = np.random.default_rng(2)
+    b, t, c = 2, 4, 3
+    z = rng.normal(size=(b, t, c)).astype(np.float32)
+    labels = np.eye(c, dtype=np.float32)[rng.integers(0, c, (b, t))]
+    mask = np.asarray([[1, 1, 0, 0], [1, 1, 1, 1]], np.float32)
+    got = _scores(labels, z, mask)
+    # hand-compute: per-timestep xent, masked, summed over time
+    zt = z.reshape(b * t, c)
+    logp = zt - np.log(np.exp(zt).sum(-1, keepdims=True))
+    per_ts = -(labels.reshape(b * t, c) * logp).sum(-1).reshape(b, t)
+    expect = (per_ts * mask).sum(-1)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_sequence_no_mask_sums_time():
+    rng = np.random.default_rng(3)
+    z = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    labels = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (2, 3))]
+    got = _scores(labels, z, None)
+    assert got.shape == (2,)
+    assert (got > 0).all()
+
+
+def test_mse_divides_by_output_count():
+    from deeplearning4j_tpu.nn.losses import l2, mse
+    labels = jnp.zeros((2, 10))
+    preds = jnp.ones((2, 10))
+    np.testing.assert_allclose(np.asarray(mse(labels, preds)), [1.0, 1.0])
+    np.testing.assert_allclose(np.asarray(l2(labels, preds)), [10.0, 10.0])
+
+
+def test_dense_stack_preserves_sequence_shape():
+    # Regression: rnn input must NOT be folded [b,t,f]->[b*t,f] by a
+    # preprocessor — Dense consumes sequences natively.
+    import numpy as np
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers_core import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(5))
+            .build())
+    assert conf.preprocessors == [None, None]
+    m = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(4, 6, 5)).astype(np.float32)
+    assert np.asarray(m.output(x)).shape == (4, 6, 3)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    labels = np.eye(3, dtype=np.float32)[
+        np.random.default_rng(1).integers(0, 3, (4, 6))]
+    mask = np.ones((4, 6), np.float32)
+    mask[0, 3:] = 0
+    loss = m.fit(DataSet(x, labels, labels_mask=mask))
+    assert np.isfinite(loss)
+
+
+def test_clip_l2_per_param_type():
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.optimize.solver import normalize_gradients
+    grads = {"layer_0": {"W": jnp.full((2, 2), 10.0), "b": jnp.asarray([0.1])},
+             "layer_1": {"W": jnp.full((2, 2), 10.0), "b": jnp.asarray([0.1])}}
+    out = normalize_gradients(grads, "clip_l2_per_param_type", 1.0)
+    # W group norm = sqrt(8*100) ≈ 28.28 -> scaled by 1/28.28
+    w_norm = np.sqrt(sum(np.sum(np.square(np.asarray(out[k]["W"])))
+                         for k in out))
+    assert abs(w_norm - 1.0) < 1e-5
+    # b group norm ≈ 0.141 < 1 -> untouched
+    np.testing.assert_allclose(np.asarray(out["layer_0"]["b"]), [0.1],
+                               rtol=1e-6)

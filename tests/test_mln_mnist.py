@@ -1,0 +1,67 @@
+"""M1 exit test: the MLPMnistSingleLayer config converges.
+
+Mirrors dl4j-examples ``MLPMnistSingleLayerExample``: 784 -> 500(relu) ->
+10(softmax, MCXENT-NLL), Nesterovs(0.006, 0.9), l2=1e-4 — trained on the
+(synthetic, see data/mnist.py) MNIST to >97% test accuracy.  Also the
+convergence smoke-test role of DL4J's ``MultiLayerTest`` training tests.
+"""
+import numpy as np
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.nn.conf.layers_core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.listeners import (CollectScoresListener,
+                                                   ScoreIterationListener)
+from deeplearning4j_tpu.optimize.updaters import Nesterovs
+
+
+def test_mnist_mlp_converges_above_97():
+    train = MnistDataSetIterator(128, train=True, seed=123, n_examples=12000)
+    test = MnistDataSetIterator(512, train=False, seed=123, n_examples=2000)
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(123)
+            .updater(Nesterovs(learning_rate=0.006, momentum=0.9))
+            .l2(1e-4)
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_in=784, n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+
+    model = MultiLayerNetwork(conf).init()
+    scores = CollectScoresListener(frequency=10)
+    model.set_listeners(ScoreIterationListener(50), scores)
+    model.fit(train, n_epochs=3)
+
+    ev = model.evaluate(test)
+    assert ev.accuracy() > 0.97, ev.stats()
+    # loss actually decreased over training
+    assert scores.scores[-1][1] < scores.scores[0][1]
+    assert model.iteration_count == 3 * int(np.ceil(12000 / 128))
+    assert model.epoch_count == 3
+
+
+def test_score_and_output_api():
+    train = MnistDataSetIterator(64, train=True, seed=5, n_examples=256)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1)
+            .list()
+            .layer(DenseLayer(n_in=784, n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    batch = next(iter(train))
+    s0 = model.score(batch)
+    assert np.isfinite(s0) and s0 > 0
+    out = np.asarray(model.output(batch.features))
+    assert out.shape == (64, 10)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+    # params round-trip through the flattened DL4J-style view
+    vec = model.params()
+    assert vec.shape == (model.num_params(),)
+    model.set_params(vec)
+    np.testing.assert_allclose(np.asarray(model.output(batch.features)),
+                               out, rtol=1e-6)

@@ -1,0 +1,97 @@
+"""Sharded-trainer tests on the 8-virtual-device CPU mesh.
+
+The DL4J analogues these replace: ParallelWrapper multi-thread tests and
+the loopback-Aeron ModelParameterServer tests (SURVEY.md §4 row
+"Distributed without a cluster") — here the collectives are REAL XLA
+all-reduces over the forced-host-platform device mesh.
+"""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.layers_core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam, Nesterovs
+from deeplearning4j_tpu.parallel import MeshConfig, ShardedTrainer
+
+
+def _toy_data(n=512, din=16, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, din)).astype(np.float32)
+    w = rng.normal(size=(din, classes)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[(x @ w).argmax(-1)]
+    return x, y
+
+
+def _model(din=16, hidden=32, classes=4, seed=9, lr=1e-2):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=lr))
+            .list()
+            .layer(DenseLayer(n_in=din, n_out=hidden, activation="relu"))
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=classes, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_requires_8_devices():
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+
+
+def test_data_parallel_training_converges():
+    x, y = _toy_data()
+    model = _model()
+    trainer = ShardedTrainer(model, MeshConfig(data=8))
+    ds = DataSet(x, y)
+    it = ListDataSetIterator(ds.batch_by(64))
+    trainer.fit(it, n_epochs=30)
+    ev = model.evaluate(it)
+    assert ev.accuracy() > 0.9, ev.stats()
+
+
+def test_dp_matches_single_device_loss_sequence():
+    # Same seed, same data: the 8-way sharded step must produce the same
+    # loss trajectory as single-device (all-reduce == big-batch math).
+    x, y = _toy_data(n=256)
+    m1 = _model(seed=4)
+    m2 = _model(seed=4)
+    losses_single, losses_dp = [], []
+    b = {"features": x, "labels": y}
+    m1._build_solver()
+    import jax.numpy as jnp
+    batch = {k: jnp.asarray(v) for k, v in b.items()}
+    for i in range(5):
+        (m1.params_tree, m1.opt_state, m1.state_tree, loss) = m1._solver.step(
+            m1.params_tree, m1.opt_state, m1.state_tree, i, dict(batch),
+            m1._rng.next_key())
+        losses_single.append(float(loss))
+    trainer = ShardedTrainer(m2, MeshConfig(data=8))
+    for i in range(5):
+        losses_dp.append(float(trainer.fit_batch(x, y)))
+    np.testing.assert_allclose(losses_single, losses_dp, rtol=2e-4)
+
+
+def test_tensor_parallel_2way_runs_and_converges():
+    x, y = _toy_data()
+    model = _model(hidden=64)
+    trainer = ShardedTrainer(model, MeshConfig(data=4, model=2))
+    # hidden kernels sharded over 'model' axis
+    w1_shard = model.params_tree["layer_0"]["W"].sharding
+    assert "model" in str(w1_shard.spec)
+    ds = DataSet(x, y)
+    it = ListDataSetIterator(ds.batch_by(64))
+    trainer.fit(it, n_epochs=30)
+    assert model.evaluate(it).accuracy() > 0.9
+
+
+def test_graft_entry_dryrun():
+    import sys
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as ge
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert np.isfinite(np.asarray(out)).all()
+    ge.dryrun_multichip(8)
