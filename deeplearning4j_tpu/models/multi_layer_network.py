@@ -81,8 +81,12 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # Pure forward/score (traced by XLA)
     # ------------------------------------------------------------------
-    def _forward_layers(self, params, state, x, training, rng, upto=None):
-        """Run layers [0, upto); returns (activation, new_state_tree)."""
+    def _forward_layers(self, params, state, x, training, rng, upto=None,
+                        mask=None):
+        """Run layers [0, upto); returns (activation, new_state_tree).
+        `mask` is the features mask ([b, t] for sequences) handed to
+        mask-aware layers (``USES_MASK``) — DL4J's setMaskArray propagation.
+        """
         compute_dtype = backend().compute_dtype
         n = len(self.layers) if upto is None else upto
         keys = (jax.random.split(rng, n) if rng is not None
@@ -93,14 +97,18 @@ class MultiLayerNetwork:
             pre = self.conf.preprocessors[i]
             if pre is not None:
                 x = pre(x)
+            kwargs = {}
+            if getattr(ly, "USES_MASK", False):
+                kwargs["mask"] = mask
             x, s = ly.apply(
                 params[f"layer_{i}"], state[f"layer_{i}"], x,
-                training=training, rng=keys[i], compute_dtype=compute_dtype)
+                training=training, rng=keys[i], compute_dtype=compute_dtype,
+                **kwargs)
             new_state[f"layer_{i}"] = s
         return x, new_state
 
-    def _forward_infer(self, params, state, x):
-        y, _ = self._forward_layers(params, state, x, False, None)
+    def _forward_infer(self, params, state, x, mask=None):
+        y, _ = self._forward_layers(params, state, x, False, None, mask=mask)
         return y
 
     def _regularization_score(self, params):
@@ -126,11 +134,13 @@ class MultiLayerNetwork:
         x = batch["features"]
         labels = batch["labels"]
         lmask = batch.get("labels_mask")
+        fmask = batch.get("features_mask")
         out_layer = self.layers[-1]
         if not isinstance(out_layer, BaseOutputLayerConf):
             raise ValueError("Last layer must be an output/loss layer for fit()")
         h, new_state = self._forward_layers(
-            params, state, x, training, rng, upto=len(self.layers) - 1)
+            params, state, x, training, rng, upto=len(self.layers) - 1,
+            mask=fmask)
         pre = self.conf.preprocessors[-1]
         if pre is not None:
             h = pre(h)
@@ -230,16 +240,20 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # Inference / scoring
     # ------------------------------------------------------------------
-    def output(self, x, training: bool = False):
+    def output(self, x, training: bool = False, features_mask=None):
         """Forward pass returning final-layer activations
-        (DL4J ``output(INDArray)``)."""
+        (DL4J ``output(INDArray[, featuresMask])``)."""
         self._check_init()
         x = jnp.asarray(x)
+        if features_mask is not None:
+            features_mask = jnp.asarray(features_mask)
         if training:
             y, _ = self._forward_layers(self.params_tree, self.state_tree, x,
-                                        True, self._rng.next_key())
+                                        True, self._rng.next_key(),
+                                        mask=features_mask)
             return y
-        return self._output_fn(self.params_tree, self.state_tree, x)
+        return self._output_fn(self.params_tree, self.state_tree, x,
+                               features_mask)
 
     def feed_forward(self, x, training: bool = False) -> List[jnp.ndarray]:
         """All per-layer activations (DL4J ``feedForward``)."""
@@ -272,7 +286,7 @@ class MultiLayerNetwork:
         self._check_init()
         ev = Evaluation(top_n=top_n)
         for ds in iterator:
-            out = self.output(ds.features)
+            out = self.output(ds.features, features_mask=ds.features_mask)
             ev.eval(ds.labels, np.asarray(out), ds.labels_mask)
         iterator.reset()
         return ev

@@ -1,0 +1,690 @@
+"""Convolutional layer configs: Conv2D/1D, Subsampling, BatchNorm, etc.
+
+Parity targets (reference paths, upstream layout):
+* ``org.deeplearning4j.nn.conf.layers.ConvolutionLayer`` + runtime
+  ``org.deeplearning4j.nn.layers.convolution.ConvolutionLayer`` (and its
+  cuDNN/oneDNN helper seam — replaced wholesale by XLA's conv lowering)
+* ``SubsamplingLayer`` (MAX/AVG/SUM/PNORM pooling)
+* ``BatchNormalization`` (+ ``CudnnBatchNormalizationHelper``)
+* ``GlobalPoolingLayer``, ``Upsampling2D``, ``ZeroPaddingLayer``,
+  ``DepthwiseConvolution2D``, ``SeparableConvolution2D``,
+  ``Deconvolution2D``, ``LocalResponseNormalization``, ``Cropping2D``,
+  ``SpaceToDepthLayer``
+
+TPU-first notes: layout is NHWC with HWIO kernels — the layout XLA's TPU
+conv emitter wants (DL4J is NCHW).  The conv itself is
+``lax.conv_general_dilated``, which XLA tiles onto the MXU; bias + ReLU
+fuse into it.  There is no helper indirection (no cuDNN algo selection, no
+im2col fallback) — that whole seam from the reference does not exist here.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.base import BaseLayerConf, register_layer
+from deeplearning4j_tpu.nn.conf.layers_core import (
+    BaseOutputLayerConf, apply_dropout)
+from deeplearning4j_tpu.nn.weights_init import init_weights
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1] if len(v) > 1 else v[0]))
+    return (int(v), int(v))
+
+
+def _conv_out(size: int, k: int, s: int, p: int, d: int, mode: str) -> int:
+    """Output spatial size per DL4J ConvolutionUtils.getOutputSize;
+    raises for ConvolutionMode.Strict when shapes don't divide exactly."""
+    eff_k = (k - 1) * d + 1
+    if mode == "same":
+        return -(-size // s)  # ceil
+    if mode == "strict" and (size + 2 * p - eff_k) % s:
+        raise ValueError(
+            f"ConvolutionMode.Strict: size {size} with kernel {k} stride "
+            f"{s} pad {p} dilation {d} does not divide exactly")
+    return (size + 2 * p - eff_k) // s + 1
+
+
+def _tblr(spec) -> Tuple[int, int, int, int]:
+    """Expand a (h, w) pair or explicit (top, bottom, left, right)."""
+    p = list(spec)
+    if len(p) == 2:
+        return p[0], p[0], p[1], p[1]
+    return p[0], p[1], p[2], p[3]
+
+
+def _padding_config(mode: str, pad: Tuple[int, int]):
+    """lax padding argument for a 2-D conv/pool."""
+    if mode == "same":
+        return "SAME"
+    return [(pad[0], pad[0]), (pad[1], pad[1])]
+
+
+@register_layer
+@dataclasses.dataclass
+class ConvolutionLayer(BaseLayerConf):
+    """2-D convolution (``org.deeplearning4j.nn.conf.layers.ConvolutionLayer``).
+
+    ``convolution_mode``: 'truncate' (DL4J default — floor division),
+    'same', or 'strict' (shape must divide exactly).  Explicit ``padding``
+    only applies to truncate/strict, as in DL4J.
+    """
+
+    kernel_size: Sequence[int] = (3, 3)
+    stride: Sequence[int] = (1, 1)
+    padding: Sequence[int] = (0, 0)
+    dilation: Sequence[int] = (1, 1)
+    convolution_mode: str = "truncate"
+    n_in: Optional[int] = None   # input channels
+    n_out: Optional[int] = None  # output channels
+    has_bias: bool = True
+
+    WANTED_KINDS = ("cnn",)
+
+    def infer_shapes(self, input_shape):
+        h, w, c = input_shape
+        if self.n_in is None:
+            self.n_in = int(c)
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        dh, dw = _pair(self.dilation)
+        mode = self.convolution_mode
+        oh = _conv_out(h, kh, sh, ph, dh, mode)
+        ow = _conv_out(w, kw, sw, pw, dw, mode)
+        return (oh, ow, self.n_out)
+
+    def has_params(self):
+        return True
+
+    def init(self, key, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        # DL4J ConvolutionParamInitializer fan conventions:
+        fan_in = self.n_in * kh * kw
+        fan_out = self.n_out * kh * kw / float(sh * sw)
+        w = init_weights(key, (kh, kw, self.n_in, self.n_out), fan_in,
+                         fan_out, self.weight_init, dtype,
+                         self.weight_distribution)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params, {}
+
+    def _conv(self, x, w):
+        mode = self.convolution_mode
+        pad = _padding_config("same" if mode == "same" else mode,
+                              _pair(self.padding))
+        return lax.conv_general_dilated(
+            x, w, window_strides=_pair(self.stride), padding=pad,
+            rhs_dilation=_pair(self.dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        w = params["W"]
+        if compute_dtype is not None:
+            x, w = x.astype(compute_dtype), w.astype(compute_dtype)
+        z = self._conv(x, w)
+        if self.has_bias:
+            z = z + params["b"].astype(z.dtype)
+        z = z.astype(params["W"].dtype)
+        y = get_activation(self.activation or "identity")(z)
+        return apply_dropout(y, self.dropout, training, rng), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed conv (``org.deeplearning4j.nn.conf.layers.Deconvolution2D``)."""
+
+    def infer_shapes(self, input_shape):
+        h, w, c = input_shape
+        if self.n_in is None:
+            self.n_in = int(c)
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        dh, dw = _pair(self.dilation)
+        if self.convolution_mode == "same":
+            oh, ow = h * sh, w * sw
+        else:
+            eff_kh, eff_kw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+            oh = sh * (h - 1) + eff_kh - 2 * ph
+            ow = sw * (w - 1) + eff_kw - 2 * pw
+        return (oh, ow, self.n_out)
+
+    def _conv(self, x, w):
+        mode = self.convolution_mode
+        if mode == "same":
+            pad = "SAME"
+        else:
+            # lax.conv_transpose pads the dilated input directly; forward-
+            # conv padding p maps to transpose padding (eff_k - 1 - p).
+            kh, kw = _pair(self.kernel_size)
+            dh, dw = _pair(self.dilation)
+            ph, pw = _pair(self.padding)
+            eff_kh, eff_kw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+            pad = [(eff_kh - 1 - ph, eff_kh - 1 - ph),
+                   (eff_kw - 1 - pw, eff_kw - 1 - pw)]
+        return lax.conv_transpose(
+            x, w, strides=_pair(self.stride), padding=pad,
+            rhs_dilation=_pair(self.dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@register_layer
+@dataclasses.dataclass
+class DepthwiseConvolution2D(BaseLayerConf):
+    """Per-channel conv (``DepthwiseConvolution2D``); output channels =
+    n_in * depth_multiplier."""
+
+    kernel_size: Sequence[int] = (3, 3)
+    stride: Sequence[int] = (1, 1)
+    padding: Sequence[int] = (0, 0)
+    dilation: Sequence[int] = (1, 1)
+    convolution_mode: str = "truncate"
+    depth_multiplier: int = 1
+    n_in: Optional[int] = None
+    has_bias: bool = True
+
+    WANTED_KINDS = ("cnn",)
+
+    def infer_shapes(self, input_shape):
+        h, w, c = input_shape
+        if self.n_in is None:
+            self.n_in = int(c)
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        dh, dw = _pair(self.dilation)
+        oh = _conv_out(h, kh, sh, ph, dh, self.convolution_mode)
+        ow = _conv_out(w, kw, sw, pw, dw, self.convolution_mode)
+        return (oh, ow, self.n_in * self.depth_multiplier)
+
+    def has_params(self):
+        return True
+
+    def init(self, key, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        n_out = self.n_in * self.depth_multiplier
+        fan_in, fan_out = kh * kw, kh * kw * self.depth_multiplier
+        w = init_weights(key, (kh, kw, 1, n_out), fan_in, fan_out,
+                         self.weight_init, dtype, self.weight_distribution)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.full((n_out,), self.bias_init, dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        w = params["W"]
+        if compute_dtype is not None:
+            x, w = x.astype(compute_dtype), w.astype(compute_dtype)
+        pad = _padding_config(
+            "same" if self.convolution_mode == "same" else "truncate",
+            _pair(self.padding))
+        z = lax.conv_general_dilated(
+            x, w, window_strides=_pair(self.stride), padding=pad,
+            rhs_dilation=_pair(self.dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_in)
+        if self.has_bias:
+            z = z + params["b"].astype(z.dtype)
+        z = z.astype(params["W"].dtype)
+        y = get_activation(self.activation or "identity")(z)
+        return apply_dropout(y, self.dropout, training, rng), state
+
+
+@register_layer
+@dataclasses.dataclass
+class SeparableConvolution2D(DepthwiseConvolution2D):
+    """Depthwise + 1x1 pointwise (``SeparableConvolution2D``)."""
+
+    n_out: Optional[int] = None
+
+    def infer_shapes(self, input_shape):
+        oh, ow, _ = super().infer_shapes(input_shape)
+        return (oh, ow, self.n_out)
+
+    def init(self, key, dtype=jnp.float32):
+        k_dw, k_pw = jax.random.split(key)
+        kh, kw = _pair(self.kernel_size)
+        mid = self.n_in * self.depth_multiplier
+        dw = init_weights(k_dw, (kh, kw, 1, mid), kh * kw,
+                          kh * kw * self.depth_multiplier, self.weight_init,
+                          dtype, self.weight_distribution)
+        pw = init_weights(k_pw, (1, 1, mid, self.n_out), mid, self.n_out,
+                          self.weight_init, dtype, self.weight_distribution)
+        params = {"W": dw, "pW": pw}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params, {}
+
+    def regularized_param_names(self):
+        return ("W", "pW")
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        w, pw = params["W"], params["pW"]
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+            w, pw = w.astype(compute_dtype), pw.astype(compute_dtype)
+        pad = _padding_config(
+            "same" if self.convolution_mode == "same" else "truncate",
+            _pair(self.padding))
+        z = lax.conv_general_dilated(
+            x, w, window_strides=_pair(self.stride), padding=pad,
+            rhs_dilation=_pair(self.dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_in)
+        z = lax.conv_general_dilated(
+            z, pw, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"].astype(z.dtype)
+        z = z.astype(params["W"].dtype)
+        y = get_activation(self.activation or "identity")(z)
+        return apply_dropout(y, self.dropout, training, rng), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Convolution1DLayer(BaseLayerConf):
+    """1-D conv over [batch, time, features]
+    (``org.deeplearning4j.nn.conf.layers.Convolution1DLayer``)."""
+
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    dilation: int = 1
+    convolution_mode: str = "same"  # DL4J Conv1D default keeps length
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    has_bias: bool = True
+
+    WANTED_KINDS = ("rnn",)
+
+    def infer_shapes(self, input_shape):
+        t, f = input_shape
+        if self.n_in is None:
+            self.n_in = int(f)
+        if t is None:
+            return (None, self.n_out)
+        if self.convolution_mode == "causal":
+            ot = -(-t // self.stride)
+        else:
+            ot = _conv_out(t, self.kernel_size, self.stride, self.padding,
+                           self.dilation, self.convolution_mode)
+        return (ot, self.n_out)
+
+    def has_params(self):
+        return True
+
+    def init(self, key, dtype=jnp.float32):
+        k = int(self.kernel_size)
+        fan_in = self.n_in * k
+        fan_out = self.n_out * k / float(self.stride)
+        w = init_weights(key, (k, self.n_in, self.n_out), fan_in, fan_out,
+                         self.weight_init, dtype, self.weight_distribution)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        w = params["W"]
+        if compute_dtype is not None:
+            x, w = x.astype(compute_dtype), w.astype(compute_dtype)
+        k, d = int(self.kernel_size), int(self.dilation)
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        elif self.convolution_mode == "causal":
+            eff_k = (k - 1) * d + 1
+            pad = [(eff_k - 1, 0)]
+        else:
+            pad = [(self.padding, self.padding)]
+        z = lax.conv_general_dilated(
+            x, w, window_strides=(self.stride,), padding=pad,
+            rhs_dilation=(d,), dimension_numbers=("NTC", "TIO", "NTC"))
+        if self.has_bias:
+            z = z + params["b"].astype(z.dtype)
+        z = z.astype(params["W"].dtype)
+        y = get_activation(self.activation or "identity")(z)
+        return apply_dropout(y, self.dropout, training, rng), state
+
+
+@register_layer
+@dataclasses.dataclass
+class SubsamplingLayer(BaseLayerConf):
+    """Pooling (``org.deeplearning4j.nn.conf.layers.SubsamplingLayer``).
+    ``pooling_type``: 'max' | 'avg' | 'sum' | 'pnorm'."""
+
+    kernel_size: Sequence[int] = (2, 2)
+    stride: Sequence[int] = (2, 2)
+    padding: Sequence[int] = (0, 0)
+    dilation: Sequence[int] = (1, 1)
+    convolution_mode: str = "truncate"
+    pooling_type: str = "max"
+    pnorm: int = 2
+
+    WANTED_KINDS = ("cnn",)
+
+    def infer_shapes(self, input_shape):
+        h, w, c = input_shape
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        dh, dw = _pair(self.dilation)
+        oh = _conv_out(h, kh, sh, ph, dh, self.convolution_mode)
+        ow = _conv_out(w, kw, sw, pw, dw, self.convolution_mode)
+        return (oh, ow, c)
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        dh, dw = _pair(self.dilation)
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        dilation = (1, dh, dw, 1)
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        else:
+            ph, pw = _pair(self.padding)
+            pad = [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+        return pool2d(x, self.pooling_type, window, strides, pad, dilation,
+                      self.pnorm), state
+
+
+def pool2d(x, pooling_type, window, strides, pad, dilation=(1, 1, 1, 1),
+           pnorm=2):
+    """Shared reduce_window pooling (used by Subsampling and graph vertices).
+    Average pooling divides by the ACTUAL window size at edges (DL4J
+    behavior with padding excluded from the count)."""
+    pt = str(pooling_type).lower()
+    if pt == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pad,
+                                 window_dilation=dilation)
+    if pt in ("avg", "sum"):
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad,
+                              window_dilation=dilation)
+        if pt == "sum":
+            return s
+        ones = jnp.ones(x.shape, x.dtype)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad,
+                                   window_dilation=dilation)
+        return s / counts
+    if pt == "pnorm":
+        p = float(pnorm)
+        s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides,
+                              pad, window_dilation=dilation)
+        return s ** (1.0 / p)
+    raise ValueError(f"Unknown pooling type {pooling_type!r}")
+
+
+@register_layer
+@dataclasses.dataclass
+class BatchNormalization(BaseLayerConf):
+    """Batch norm over the channel axis
+    (``org.deeplearning4j.nn.conf.layers.BatchNormalization`` +
+    ``CudnnBatchNormalizationHelper`` — on TPU the whole thing is a couple
+    of fused XLA reductions; no helper).
+
+    Running stats live in the layer STATE tree and are updated as a side
+    output of the jitted step — the functional equivalent of DL4J mutating
+    its global mean/var params with ``decay``.
+    """
+
+    n_out: Optional[int] = None  # channel count (inferred)
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+    use_global_stats: bool = False  # DL4J useMinibatch=false analogue
+
+    WANTED_KINDS = ("ff", "cnn", "rnn")
+
+    def infer_shapes(self, input_shape):
+        self.n_out = int(input_shape[-1])
+        return input_shape
+
+    def has_params(self):
+        return not self.lock_gamma_beta
+
+    def init(self, key, dtype=jnp.float32):
+        c = self.n_out
+        params = {} if self.lock_gamma_beta else {
+            "gamma": jnp.ones((c,), dtype), "beta": jnp.zeros((c,), dtype)}
+        state = {"mean": jnp.zeros((c,), jnp.float32),
+                 "var": jnp.ones((c,), jnp.float32)}
+        return params, state
+
+    def regularized_param_names(self):
+        return ()
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        axes = tuple(range(x.ndim - 1))
+        if training and not self.use_global_stats:
+            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            d = self.decay
+            new_state = {"mean": d * state["mean"] + (1 - d) * mean,
+                         "var": d * state["var"] + (1 - d) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+        if not self.lock_gamma_beta:
+            y = y * params["gamma"] + params["beta"]
+        y = get_activation(self.activation or "identity")(y)
+        return y, new_state
+
+
+@register_layer
+@dataclasses.dataclass
+class GlobalPoolingLayer(BaseLayerConf):
+    """Pool away all spatial/time dims (``GlobalPoolingLayer``): cnn
+    [b,h,w,c] -> [b,c]; rnn [b,t,f] -> [b,f] honoring the feature mask
+    exactly as DL4J's masked global pooling does."""
+
+    pooling_type: str = "max"
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    WANTED_KINDS = ("cnn", "rnn")
+    USES_MASK = True
+
+    @property
+    def OUTPUT_KIND(self):
+        # collapse_dimensions=False keeps size-1 spatial/time dims and the
+        # input kind, as DL4J does.
+        return "ff" if self.collapse_dimensions else None
+
+    def infer_shapes(self, input_shape):
+        if self.collapse_dimensions:
+            return (input_shape[-1],)
+        return tuple(1 for _ in input_shape[:-1]) + (input_shape[-1],)
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None, mask=None):
+        axes = tuple(range(1, x.ndim - 1))
+        keep = not self.collapse_dimensions
+        pt = self.pooling_type.lower()
+        if mask is not None and x.ndim == 3:
+            m = (mask[..., 0] if mask.ndim == 3 else mask)[..., None]
+            m = m.astype(x.dtype)
+            n_valid = jnp.sum(m, axis=1, keepdims=keep)
+            if pt == "max":
+                # Fully-masked rows pool to 0, not -inf (avoids NaN grads).
+                lo = jnp.finfo(x.dtype).min
+                y = jnp.max(jnp.where(m > 0, x, lo), axis=1, keepdims=keep)
+                y = jnp.where(n_valid > 0, y, 0.0)
+                return y, state
+            x = x * m
+            if pt == "avg":
+                return (jnp.sum(x, axis=1, keepdims=keep)
+                        / jnp.maximum(n_valid, 1.0)), state
+        if pt == "max":
+            return jnp.max(x, axis=axes, keepdims=keep), state
+        if pt == "avg":
+            return jnp.mean(x, axis=axes, keepdims=keep), state
+        if pt == "sum":
+            return jnp.sum(x, axis=axes, keepdims=keep), state
+        if pt == "pnorm":
+            p = float(self.pnorm)
+            return (jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=keep)
+                    ** (1.0 / p)), state
+        raise ValueError(f"Unknown pooling type {self.pooling_type!r}")
+
+
+@register_layer
+@dataclasses.dataclass
+class ZeroPaddingLayer(BaseLayerConf):
+    """Spatial zero padding (``ZeroPaddingLayer``); padding is
+    (top, bottom, left, right) or a (h, w) pair."""
+
+    padding: Sequence[int] = (1, 1)
+
+    WANTED_KINDS = ("cnn",)
+
+    def _tblr(self):
+        return _tblr(self.padding)
+
+    def infer_shapes(self, input_shape):
+        h, w, c = input_shape
+        t, b, l, r = self._tblr()
+        return (h + t + b, w + l + r, c)
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        t, b, l, r = self._tblr()
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Cropping2D(BaseLayerConf):
+    """Spatial crop (``Cropping2D``): (top, bottom, left, right)."""
+
+    cropping: Sequence[int] = (0, 0, 0, 0)
+
+    WANTED_KINDS = ("cnn",)
+
+    def _tblr(self):
+        return _tblr(self.cropping)
+
+    def infer_shapes(self, input_shape):
+        h, w, c = input_shape
+        t, b, l, r = self._tblr()
+        return (h - t - b, w - l - r, c)
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        t, b, l, r = self._tblr()
+        return x[:, t:x.shape[1] - b, l:x.shape[2] - r, :], state
+
+
+@register_layer
+@dataclasses.dataclass
+class Upsampling2D(BaseLayerConf):
+    """Nearest-neighbor upsample (``Upsampling2D``)."""
+
+    size: Sequence[int] = (2, 2)
+
+    WANTED_KINDS = ("cnn",)
+
+    def infer_shapes(self, input_shape):
+        h, w, c = input_shape
+        sh, sw = _pair(self.size)
+        return (h * sh, w * sw, c)
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        sh, sw = _pair(self.size)
+        y = jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+        return y, state
+
+
+@register_layer
+@dataclasses.dataclass
+class SpaceToDepthLayer(BaseLayerConf):
+    """Rearrange spatial blocks into channels (``SpaceToDepthLayer``)."""
+
+    block_size: int = 2
+
+    WANTED_KINDS = ("cnn",)
+
+    def infer_shapes(self, input_shape):
+        h, w, c = input_shape
+        b = self.block_size
+        return (h // b, w // b, c * b * b)
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        n, h, w, c = x.shape
+        b = self.block_size
+        y = x.reshape(n, h // b, b, w // b, b, c)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b,
+                                                  c * b * b)
+        return y, state
+
+
+@register_layer
+@dataclasses.dataclass
+class LocalResponseNormalization(BaseLayerConf):
+    """AlexNet-era LRN (``LocalResponseNormalization``); DL4J defaults
+    k=2, n=5, alpha=1e-4, beta=0.75."""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    WANTED_KINDS = ("cnn",)
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        half = self.n // 2
+        sq = jnp.square(x)
+        # Sum over a window of `n` adjacent channels via padded reduce.
+        padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, half)))
+        window = sum(padded[..., i:i + x.shape[-1]]
+                     for i in range(2 * half + 1))
+        return x / (self.k + self.alpha * window) ** self.beta, state
+
+
+@register_layer
+@dataclasses.dataclass
+class CnnLossLayer(BaseOutputLayerConf):
+    """Per-pixel loss over [b,h,w,c] (``CnnLossLayer``); the network's
+    output plumbing calls ``per_example_score`` below."""
+
+    WANTED_KINDS = ("cnn",)
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None):
+        return get_activation(self.activation or "identity")(x), state
+
+    def pre_output(self, params, x, compute_dtype=None):
+        return x
+
+    def per_example_score(self, labels, z, mask=None):
+        # Fold [b,h,w,c] to the sequence shape [b,h*w,c] and reuse the base
+        # per-timestep masked scoring (one fused-loss dispatch to maintain).
+        b, c = z.shape[0], z.shape[-1]
+        z2 = z.reshape(b, -1, c)
+        lab2 = labels.reshape(b, -1, labels.shape[-1])
+        m2 = None if mask is None else mask.reshape(b, -1)
+        return super().per_example_score(lab2, z2, m2)

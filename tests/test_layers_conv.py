@@ -1,0 +1,311 @@
+"""Conv-stack tests: shape inference, numerics, and an end-to-end CNN fit.
+
+Mirrors the reference's ConvolutionTests*/SubsamplingLayerTest/
+BatchNormalizationTest coverage (SURVEY.md §4) with numpy golden checks.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers_conv import (
+    BatchNormalization, CnnLossLayer, ConvolutionLayer, Convolution1DLayer,
+    Cropping2D, Deconvolution2D, DepthwiseConvolution2D, GlobalPoolingLayer,
+    LocalResponseNormalization, SeparableConvolution2D, SpaceToDepthLayer,
+    SubsamplingLayer, Upsampling2D, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.conf.layers_core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+
+def _apply(ly, x, key_seed=0, training=False):
+    import jax
+    ly.resolve_defaults(__import__(
+        "deeplearning4j_tpu.nn.conf.base", fromlist=["GlobalConf"]
+    ).GlobalConf())
+    ly.infer_shapes(tuple(x.shape[1:]))
+    params, state = ly.init(jax.random.PRNGKey(key_seed))
+    y, new_state = ly.apply(params, state, jnp.asarray(x),
+                            training=training,
+                            rng=jax.random.PRNGKey(1))
+    return np.asarray(y), params, new_state
+
+
+class TestConv2D:
+    def test_shape_truncate(self):
+        ly = ConvolutionLayer(kernel_size=(3, 3), stride=(2, 2), n_out=8)
+        out = ly.infer_shapes((28, 28, 1))
+        assert out == (13, 13, 8)  # floor((28-3)/2)+1
+
+    def test_shape_same(self):
+        ly = ConvolutionLayer(kernel_size=(3, 3), stride=(2, 2), n_out=8,
+                              convolution_mode="same")
+        assert ly.infer_shapes((28, 28, 1)) == (14, 14, 8)
+
+    def test_strict_raises(self):
+        ly = ConvolutionLayer(kernel_size=(3, 3), stride=(2, 2), n_out=8,
+                              convolution_mode="strict")
+        with pytest.raises(ValueError):
+            ly.infer_shapes((28, 28, 1))
+
+    def test_identity_kernel_numerics(self, rng):
+        # 1x1 conv with identity weights = passthrough + bias
+        ly = ConvolutionLayer(kernel_size=(1, 1), n_in=2, n_out=2,
+                              weight_init="identity_by_hand", bias_init=0.5)
+        x = rng.normal(size=(2, 4, 4, 2)).astype(np.float32)
+        import jax
+        ly.resolve_defaults(__import__(
+            "deeplearning4j_tpu.nn.conf.base", fromlist=["GlobalConf"]
+        ).GlobalConf())
+        params, state = {"W": jnp.eye(2).reshape(1, 1, 2, 2),
+                         "b": jnp.full((2,), 0.5)}, {}
+        y, _ = ly.apply(params, state, jnp.asarray(x), training=False)
+        np.testing.assert_allclose(np.asarray(y), x + 0.5, rtol=1e-6)
+
+    def test_matches_manual_conv(self, rng):
+        # golden check vs direct correlation for a single output pixel
+        x = rng.normal(size=(1, 5, 5, 3)).astype(np.float32)
+        ly = ConvolutionLayer(kernel_size=(3, 3), n_out=4, has_bias=False)
+        y, params, _ = _apply(ly, x)
+        w = np.asarray(params["W"])  # HWIO
+        expected = np.sum(x[0, 0:3, 0:3, :, None] * w, axis=(0, 1, 2))
+        np.testing.assert_allclose(y[0, 0, 0], expected, rtol=1e-4)
+
+
+class TestPooling:
+    def test_max_pool(self, rng):
+        x = rng.normal(size=(2, 4, 4, 3)).astype(np.float32)
+        ly = SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2))
+        y, _, _ = _apply(ly, x)
+        expected = x.reshape(2, 2, 2, 2, 2, 3).max(axis=(2, 4))
+        np.testing.assert_allclose(y, expected, rtol=1e-6)
+
+    def test_avg_pool_edge_counts(self):
+        # 3x3 input, 2x2 window stride 2 with 'same' -> edge windows divide
+        # by the true element count, not the window area
+        x = np.arange(9, dtype=np.float32).reshape(1, 3, 3, 1)
+        ly = SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                              pooling_type="avg", convolution_mode="same")
+        y, _, _ = _apply(ly, x)
+        assert y.shape == (1, 2, 2, 1)
+        np.testing.assert_allclose(y[0, 0, 0, 0], np.mean([0, 1, 3, 4]))
+        np.testing.assert_allclose(y[0, 1, 1, 0], 8.0)  # single element
+
+    def test_pnorm(self, rng):
+        x = np.abs(rng.normal(size=(1, 2, 2, 1))).astype(np.float32)
+        ly = SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                              pooling_type="pnorm", pnorm=2)
+        y, _, _ = _apply(ly, x)
+        np.testing.assert_allclose(y.ravel(),
+                                   np.linalg.norm(x.ravel()), rtol=1e-5)
+
+
+class TestBatchNorm:
+    def test_normalizes_training_batch(self, rng):
+        x = (rng.normal(size=(64, 8)) * 5 + 3).astype(np.float32)
+        ly = BatchNormalization()
+        y, params, state = _apply(ly, x, training=True)
+        np.testing.assert_allclose(y.mean(axis=0), 0.0, atol=1e-3)
+        np.testing.assert_allclose(y.std(axis=0), 1.0, atol=1e-2)
+        # running stats moved toward batch stats with decay 0.9
+        np.testing.assert_allclose(np.asarray(state["mean"]),
+                                   0.1 * x.mean(axis=0), rtol=1e-3)
+
+    def test_inference_uses_running_stats(self, rng):
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        ly = BatchNormalization()
+        ly.infer_shapes((4,))
+        import jax
+        ly.resolve_defaults(__import__(
+            "deeplearning4j_tpu.nn.conf.base", fromlist=["GlobalConf"]
+        ).GlobalConf())
+        params, state = ly.init(jax.random.PRNGKey(0))
+        state = {"mean": jnp.full((4,), 2.0), "var": jnp.full((4,), 4.0)}
+        y, new_state = ly.apply(params, state, jnp.asarray(x),
+                                training=False)
+        np.testing.assert_allclose(np.asarray(y), (x - 2.0) / np.sqrt(4.0 + 1e-5),
+                                   rtol=1e-4)
+        assert new_state is state  # no update at inference
+
+    def test_cnn_input(self, rng):
+        x = rng.normal(size=(4, 5, 5, 3)).astype(np.float32)
+        y, _, _ = _apply(BatchNormalization(), x, training=True)
+        np.testing.assert_allclose(y.mean(axis=(0, 1, 2)), 0.0, atol=1e-3)
+
+
+class TestShapeLayers:
+    def test_zero_padding(self, rng):
+        x = rng.normal(size=(1, 4, 4, 2)).astype(np.float32)
+        y, _, _ = _apply(ZeroPaddingLayer(padding=(1, 2)), x)
+        assert y.shape == (1, 6, 8, 2)
+        np.testing.assert_allclose(y[0, 1:5, 2:6], x[0])
+
+    def test_crop(self, rng):
+        x = rng.normal(size=(1, 6, 6, 2)).astype(np.float32)
+        y, _, _ = _apply(Cropping2D(cropping=(1, 2)), x)
+        np.testing.assert_allclose(y, x[:, 1:5, 2:4])
+
+    def test_upsample(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 2, 2, 1)
+        y, _, _ = _apply(Upsampling2D(size=(2, 2)), x)
+        assert y.shape == (1, 4, 4, 1)
+        np.testing.assert_allclose(y[0, :, :, 0],
+                                   np.repeat(np.repeat(x[0, :, :, 0], 2, 0),
+                                             2, 1))
+
+    def test_space_to_depth(self, rng):
+        x = rng.normal(size=(1, 4, 4, 3)).astype(np.float32)
+        y, _, _ = _apply(SpaceToDepthLayer(block_size=2), x)
+        assert y.shape == (1, 2, 2, 12)
+
+    def test_global_pooling_masked_avg(self):
+        x = np.ones((2, 4, 3), np.float32)
+        x[0, 2:] = 100.0  # masked-out region
+        ly = GlobalPoolingLayer(pooling_type="avg")
+        import jax
+        ly.resolve_defaults(__import__(
+            "deeplearning4j_tpu.nn.conf.base", fromlist=["GlobalConf"]
+        ).GlobalConf())
+        mask = np.array([[1, 1, 0, 0], [1, 1, 1, 1]], np.float32)
+        y, _ = ly.apply({}, {}, jnp.asarray(x), training=False,
+                        mask=jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(y)[0], 1.0, rtol=1e-6)
+
+    def test_lrn_shape(self, rng):
+        x = rng.normal(size=(2, 4, 4, 8)).astype(np.float32)
+        y, _, _ = _apply(LocalResponseNormalization(), x)
+        assert y.shape == x.shape
+        assert np.all(np.abs(y) <= np.abs(x) + 1e-6)
+
+
+class TestVariantConvs:
+    def test_depthwise(self, rng):
+        x = rng.normal(size=(1, 6, 6, 3)).astype(np.float32)
+        y, _, _ = _apply(DepthwiseConvolution2D(kernel_size=(3, 3),
+                                                depth_multiplier=2), x)
+        assert y.shape == (1, 4, 4, 6)
+
+    def test_separable(self, rng):
+        x = rng.normal(size=(1, 6, 6, 3)).astype(np.float32)
+        y, _, _ = _apply(SeparableConvolution2D(kernel_size=(3, 3), n_out=5),
+                         x)
+        assert y.shape == (1, 4, 4, 5)
+
+    def test_deconv_inverts_stride(self, rng):
+        x = rng.normal(size=(1, 4, 4, 2)).astype(np.float32)
+        y, _, _ = _apply(Deconvolution2D(kernel_size=(2, 2), stride=(2, 2),
+                                         n_out=3), x)
+        assert y.shape == (1, 8, 8, 3)
+
+    def test_conv1d_causal(self, rng):
+        x = rng.normal(size=(2, 10, 4)).astype(np.float32)
+        ly = Convolution1DLayer(kernel_size=3, n_out=6,
+                                convolution_mode="causal")
+        y, params, _ = _apply(ly, x)
+        assert y.shape == (2, 10, 6)
+        # causality: output at t=0 depends only on input at t=0
+        x2 = x.copy()
+        x2[:, 5:] += 10.0
+        import jax
+        y2, _ = ly.apply(params, {}, jnp.asarray(x2), training=False)
+        np.testing.assert_allclose(np.asarray(y2)[:, :5], y[:, :5],
+                                   rtol=1e-4)
+
+
+class TestEndToEndCnn:
+    def test_lenet_mnist_smoke(self, rng):
+        """LeNet-style net fits random 14x14 data: loss must drop and the
+        whole pipeline (cnn_flat input, preprocessors, conv/pool/bn/dense)
+        must wire up via shape inference alone."""
+        conf = (NeuralNetConfiguration.builder().seed(12)
+                .updater(Adam(learning_rate=1e-2))
+                .list()
+                .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=8,
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(BatchNormalization())
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(14, 14, 1))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        x = rng.normal(size=(64, 14, 14, 1)).astype(np.float32)
+        labels = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+        ds = DataSet(x, labels)
+        first = model.score(ds)
+        for _ in range(30):
+            model.fit(ds)
+        assert model.score(ds) < first * 0.5
+        out = model.output(x)
+        assert out.shape == (64, 4)
+        np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0,
+                                   rtol=1e-4)
+
+    def test_cnn_loss_layer(self, rng):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(learning_rate=1e-2))
+                .list()
+                .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=3,
+                                        convolution_mode="same"))
+                .layer(CnnLossLayer(activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 2))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        x = rng.normal(size=(4, 8, 8, 2)).astype(np.float32)
+        labels = np.eye(3, dtype=np.float32)[
+            rng.integers(0, 3, (4, 8, 8))]
+        ds = DataSet(x, labels)
+        first = model.score(ds)
+        for _ in range(20):
+            model.fit(ds)
+        assert model.score(ds) < first
+
+
+class TestReviewFixes:
+    def test_strict_pooling_raises(self):
+        ly = SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                              convolution_mode="strict")
+        with pytest.raises(ValueError):
+            ly.infer_shapes((29, 29, 3))
+
+    def test_global_pooling_fully_masked_row(self):
+        x = np.ones((2, 3, 4), np.float32)
+        ly = GlobalPoolingLayer(pooling_type="max")
+        mask = np.array([[0, 0, 0], [1, 1, 1]], np.float32)
+        y, _ = ly.apply({}, {}, jnp.asarray(x), training=False,
+                        mask=jnp.asarray(mask))
+        y = np.asarray(y)
+        assert np.all(np.isfinite(y))
+        np.testing.assert_allclose(y[0], 0.0)
+        np.testing.assert_allclose(y[1], 1.0)
+
+    def test_global_pooling_keep_dims(self, rng):
+        x = rng.normal(size=(2, 4, 4, 3)).astype(np.float32)
+        ly = GlobalPoolingLayer(pooling_type="avg",
+                                collapse_dimensions=False)
+        assert ly.infer_shapes((4, 4, 3)) == (1, 1, 3)
+        y, _ = ly.apply({}, {}, jnp.asarray(x), training=False)
+        assert y.shape == (2, 1, 1, 3)
+
+    def test_mask_reaches_global_pooling_via_network(self, rng):
+        """features_mask on the DataSet must flow into GlobalPoolingLayer
+        (DL4J mask propagation)."""
+        from deeplearning4j_tpu.nn.conf.layers_core import DenseLayer
+        conf = (NeuralNetConfiguration.builder().seed(4)
+                .updater(Adam(learning_rate=1e-3)).list()
+                .layer(GlobalPoolingLayer(pooling_type="avg"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.recurrent(3, 5))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+        mask = np.array([[1, 1, 0, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+        out_masked = np.asarray(model.output(x, features_mask=mask))
+        # zeroing the masked-out region must not change the output
+        x2 = x.copy()
+        x2[0, 2:] = 77.0
+        out2 = np.asarray(model.output(x2, features_mask=mask))
+        np.testing.assert_allclose(out_masked, out2, rtol=1e-5)
