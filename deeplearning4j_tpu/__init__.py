@@ -23,9 +23,11 @@ __version__ = "0.1.0"
 
 from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
 from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph
 
 __all__ = [
     "NeuralNetConfiguration",
     "MultiLayerNetwork",
+    "ComputationGraph",
     "__version__",
 ]

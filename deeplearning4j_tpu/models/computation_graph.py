@@ -1,0 +1,758 @@
+"""ComputationGraph: the DAG model (multi-input / multi-output).
+
+Parity with ``org.deeplearning4j.nn.graph.ComputationGraph`` and its conf
+(``ComputationGraphConfiguration.GraphBuilder``): named vertices wired by
+name, topological-order execution, implicit merge when a layer has several
+inputs, multiple output layers whose losses sum.
+
+TPU-first execution: DL4J walks ``GraphVertex[]`` eagerly twice per step
+(doForward then doBackward, one JNI crossing per op).  Here the whole DAG
+— every vertex, every loss head, ``jax.grad``, and the updater — traces to
+ONE XLA program per training step; the topological walk happens once at
+trace time, then exists only as fused HLO.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterator import (
+    AsyncDataSetIterator, DataSetIterator, ListDataSetIterator)
+from deeplearning4j_tpu.eval.classification import Evaluation
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROCMultiClass
+from deeplearning4j_tpu.nn.conf.base import (
+    BaseLayerConf, GlobalConf, layer_from_dict)
+from deeplearning4j_tpu.nn.conf.graph_vertices import (
+    BaseGraphVertex, MergeVertex, vertex_from_dict)
+from deeplearning4j_tpu.nn.conf.inputs import InputType, Preprocessor, adapt
+from deeplearning4j_tpu.nn.conf.layers_core import BaseOutputLayerConf
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.optimize.solver import Solver
+from deeplearning4j_tpu.optimize.updaters import updater_from_dict
+from deeplearning4j_tpu.runtime.backend import backend
+from deeplearning4j_tpu.runtime.dtype import canonical_dtype
+from deeplearning4j_tpu.runtime.rng import RngKeyManager
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+@dataclasses.dataclass
+class VertexSpec:
+    """One named DAG node: either a layer (with optional auto-inserted
+    preprocessor — DL4J ``LayerVertex`` wraps layer + InputPreProcessor)
+    or a combining GraphVertex."""
+
+    layer: Optional[BaseLayerConf] = None
+    vertex: Optional[BaseGraphVertex] = None
+    preprocessor: Optional[Preprocessor] = None
+
+    def to_dict(self):
+        d: Dict[str, Any] = {}
+        if self.layer is not None:
+            d["layer"] = self.layer.to_dict()
+        if self.vertex is not None:
+            d["vertex"] = self.vertex.to_dict()
+        if self.preprocessor is not None:
+            d["preprocessor"] = self.preprocessor.to_dict()
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        return VertexSpec(
+            layer=layer_from_dict(d["layer"]) if d.get("layer") else None,
+            vertex=vertex_from_dict(d["vertex"]) if d.get("vertex") else None,
+            preprocessor=(Preprocessor.from_dict(d["preprocessor"])
+                          if d.get("preprocessor") else None),
+        )
+
+
+def _topological_order(network_inputs: Sequence[str],
+                       vertex_inputs: Dict[str, Sequence[str]]) -> List[str]:
+    """Kahn's algorithm over vertex names (DL4J
+    ``ComputationGraph.topologicalSortOrder``)."""
+    produced = set(network_inputs)
+    remaining = dict(vertex_inputs)
+    order: List[str] = []
+    while remaining:
+        ready = [n for n, ins in remaining.items()
+                 if all(i in produced for i in ins)]
+        if not ready:
+            unresolved = {n: [i for i in ins if i not in produced]
+                          for n, ins in remaining.items()}
+            raise ValueError(f"Graph has a cycle or missing inputs: {unresolved}")
+        for n in sorted(ready):
+            order.append(n)
+            produced.add(n)
+            del remaining[n]
+    return order
+
+
+class GraphBuilder:
+    """Fluent DAG builder (DL4J
+    ``ComputationGraphConfiguration.GraphBuilder``)."""
+
+    def __init__(self, parent):
+        self._parent = parent  # nn.conf.builder.Builder (global defaults)
+        self._inputs: List[str] = []
+        self._outputs: List[str] = []
+        self._vertices: Dict[str, VertexSpec] = {}
+        self._vertex_inputs: Dict[str, List[str]] = {}
+        self._input_types: Dict[str, InputType] = {}
+        self._backprop_type: str = "standard"
+        self._tbptt_fwd: Optional[int] = None
+        self._tbptt_bwd: Optional[int] = None
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        for n in names:
+            self._check_name(n)
+            self._inputs.append(n)
+        return self
+
+    def set_input_types(self, *types: InputType) -> "GraphBuilder":
+        """Positional, matching ``add_inputs`` order (DL4J setInputTypes)."""
+        for name, it in zip(self._inputs, types):
+            self._input_types[name] = it
+        return self
+
+    def _check_name(self, name: str):
+        if name in self._vertices or name in self._inputs:
+            raise ValueError(f"Duplicate vertex name {name!r}")
+        return name
+
+    def add_layer(self, name: str, layer: BaseLayerConf,
+                  *inputs: str) -> "GraphBuilder":
+        self._check_name(name)
+        if layer.name is None:
+            layer.name = name
+        self._vertices[name] = VertexSpec(layer=layer)
+        self._vertex_inputs[name] = list(inputs)
+        return self
+
+    def add_vertex(self, name: str, vertex: BaseGraphVertex,
+                   *inputs: str) -> "GraphBuilder":
+        self._check_name(name)
+        self._vertices[name] = VertexSpec(vertex=vertex)
+        self._vertex_inputs[name] = list(inputs)
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._outputs = list(names)
+        return self
+
+    def backprop_type(self, kind: str, tbptt_fwd: int = None,
+                      tbptt_bwd: int = None) -> "GraphBuilder":
+        self._backprop_type = str(kind).lower()
+        self._tbptt_fwd = tbptt_fwd
+        self._tbptt_bwd = tbptt_bwd or tbptt_fwd
+        return self
+
+    def build(self) -> "ComputationGraphConfiguration":
+        if not self._inputs:
+            raise ValueError("add_inputs(...) required")
+        if not self._outputs:
+            raise ValueError("set_outputs(...) required")
+        for name in self._outputs:
+            if name not in self._vertices:
+                raise ValueError(f"Output {name!r} is not a vertex")
+        for name, ins in self._vertex_inputs.items():
+            for i in ins:
+                if i not in self._vertices and i not in self._inputs:
+                    raise ValueError(f"Vertex {name!r} input {i!r} undefined")
+            spec = self._vertices[name]
+            if spec.vertex is not None:
+                lo, hi = spec.vertex.n_inputs()
+                if len(ins) < lo or (hi is not None and len(ins) > hi):
+                    raise ValueError(
+                        f"Vertex {name!r} ({type(spec.vertex).__name__}) "
+                        f"accepts {lo}..{hi if hi is not None else 'N'} "
+                        f"inputs, got {len(ins)}")
+        g = self._parent._g
+        for spec in self._vertices.values():
+            if spec.layer is not None:
+                spec.layer.resolve_defaults(g)
+
+        order = _topological_order(self._inputs, self._vertex_inputs)
+
+        # InputType propagation + preprocessor insertion + n_in auto-fill
+        # (DL4J GraphBuilder#build with setInputTypes).  Skipped entirely
+        # when no input types were given — then every layer must be fully
+        # specified, as in DL4J without setInputTypes.
+        if self._input_types:
+            types: Dict[str, InputType] = dict(self._input_types)
+            missing = [n for n in self._inputs if n not in types]
+            if missing:
+                raise ValueError(f"set_input_types missing for {missing}")
+            for name in order:
+                spec = self._vertices[name]
+                in_types = [types[i] for i in self._vertex_inputs[name]]
+                if spec.layer is not None:
+                    it = (in_types[0] if len(in_types) == 1
+                          else MergeVertex().infer_shapes(in_types))
+                    ly = spec.layer
+                    if "any" in ly.WANTED_KINDS or it.kind in ly.WANTED_KINDS:
+                        adapted = it
+                    else:
+                        err = None
+                        for kind in ly.WANTED_KINDS:
+                            try:
+                                spec.preprocessor, adapted = adapt(it, kind)
+                                break
+                            except ValueError as e:
+                                err = e
+                        else:
+                            raise ValueError(f"Vertex {name!r}: {err}")
+                    out_shape = ly.infer_shapes(adapted.shape)
+                    out_kind = getattr(ly, "OUTPUT_KIND", None) or adapted.kind
+                    types[name] = InputType(out_kind, tuple(out_shape))
+                else:
+                    types[name] = spec.vertex.infer_shapes(in_types)
+
+        return ComputationGraphConfiguration(
+            global_conf=g,
+            network_inputs=list(self._inputs),
+            network_outputs=list(self._outputs),
+            vertices=self._vertices,
+            vertex_inputs=self._vertex_inputs,
+            input_types={k: v for k, v in self._input_types.items()},
+            topological_order=order,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_bwd_length=self._tbptt_bwd,
+            grad_normalization=self._parent.grad_normalization,
+            grad_norm_threshold=self._parent.grad_norm_threshold,
+        )
+
+
+@dataclasses.dataclass
+class ComputationGraphConfiguration:
+    """Serializable DAG config (DL4J ``ComputationGraphConfiguration`` —
+    the JSON inside every graph checkpoint)."""
+
+    global_conf: GlobalConf
+    network_inputs: List[str]
+    network_outputs: List[str]
+    vertices: Dict[str, VertexSpec]
+    vertex_inputs: Dict[str, List[str]]
+    input_types: Dict[str, InputType] = dataclasses.field(default_factory=dict)
+    topological_order: List[str] = dataclasses.field(default_factory=list)
+    backprop_type: str = "standard"
+    tbptt_fwd_length: Optional[int] = None
+    tbptt_bwd_length: Optional[int] = None
+    grad_normalization: Optional[str] = None
+    grad_norm_threshold: float = 1.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "format": "deeplearning4j_tpu/ComputationGraphConfiguration/v1",
+            "global_conf": dataclasses.asdict(self.global_conf),
+            "network_inputs": self.network_inputs,
+            "network_outputs": self.network_outputs,
+            "vertices": {n: s.to_dict() for n, s in self.vertices.items()},
+            "vertex_inputs": self.vertex_inputs,
+            "input_types": {n: t.to_dict() for n, t in self.input_types.items()},
+            "topological_order": self.topological_order,
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_bwd_length": self.tbptt_bwd_length,
+            "grad_normalization": self.grad_normalization,
+            "grad_norm_threshold": self.grad_norm_threshold,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ComputationGraphConfiguration":
+        conf = ComputationGraphConfiguration(
+            global_conf=GlobalConf(**d["global_conf"]),
+            network_inputs=list(d["network_inputs"]),
+            network_outputs=list(d["network_outputs"]),
+            vertices={n: VertexSpec.from_dict(s)
+                      for n, s in d["vertices"].items()},
+            vertex_inputs={n: list(v) for n, v in d["vertex_inputs"].items()},
+            input_types={n: InputType.from_dict(t)
+                         for n, t in d.get("input_types", {}).items()},
+            topological_order=list(d.get("topological_order", [])),
+            backprop_type=d.get("backprop_type", "standard"),
+            tbptt_fwd_length=d.get("tbptt_fwd_length"),
+            tbptt_bwd_length=d.get("tbptt_bwd_length"),
+            grad_normalization=d.get("grad_normalization"),
+            grad_norm_threshold=d.get("grad_norm_threshold", 1.0),
+        )
+        if not conf.topological_order:
+            conf.topological_order = _topological_order(
+                conf.network_inputs, conf.vertex_inputs)
+        return conf
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        return ComputationGraphConfiguration.from_dict(json.loads(s))
+
+
+class TrainState(NamedTuple):
+    """Carried state of ``compiled_train_step`` (pytree)."""
+
+    params: Any
+    opt_state: Any
+    model_state: Any
+    step: jnp.ndarray
+
+
+class ComputationGraph:
+    """Runtime twin of the configuration (DL4J
+    ``org.deeplearning4j.nn.graph.ComputationGraph``)."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.params_tree = None
+        self.state_tree = None
+        self.opt_state = None
+        self.listeners: List[TrainingListener] = []
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self._rng = RngKeyManager(conf.global_conf.seed)
+        self._dtype = canonical_dtype(conf.global_conf.dtype)
+        cd = getattr(conf.global_conf, "compute_dtype", None)
+        self._compute_dtype = (canonical_dtype(cd) if cd
+                               else backend().compute_dtype)
+        self._updater = updater_from_dict(conf.global_conf.updater)
+        self._solver: Optional[Solver] = None
+        self._output_fn = jax.jit(self._forward_infer)
+        self._score_fn = jax.jit(self._score_batch_infer)
+
+    # ------------------------------------------------------------------
+    def vertex_names(self) -> List[str]:
+        return list(self.conf.topological_order)
+
+    def _layer_vertices(self):
+        for name in self.conf.topological_order:
+            spec = self.conf.vertices[name]
+            if spec.layer is not None:
+                yield name, spec.layer
+
+    @property
+    def output_layers(self) -> List[BaseOutputLayerConf]:
+        return [self.conf.vertices[n].layer for n in self.conf.network_outputs]
+
+    # ------------------------------------------------------------------
+    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        if seed is not None:
+            self._rng.reset(seed)
+        names = [n for n, _ in self._layer_vertices()]
+        keys = self._rng.next_keys(len(names))
+        params, states = {}, {}
+        for name in self.conf.topological_order:
+            params[name], states[name] = {}, {}
+        for (name, ly), key in zip(self._layer_vertices(), keys):
+            params[name], states[name] = ly.init(key, self._dtype)
+        self.params_tree = params
+        self.state_tree = states
+        self.opt_state = None
+        return self
+
+    def _check_init(self):
+        if self.params_tree is None:
+            self.init()
+
+    # ------------------------------------------------------------------
+    # Pure forward (traced by XLA)
+    # ------------------------------------------------------------------
+    def _as_input_dict(self, x) -> Dict[str, Any]:
+        if isinstance(x, dict):
+            return x
+        if isinstance(x, (list, tuple)):
+            return dict(zip(self.conf.network_inputs, x))
+        return {self.conf.network_inputs[0]: x}
+
+    def _forward_all(self, params, state, inputs: Dict[str, Any], training,
+                     rng, masks: Optional[Dict[str, Any]] = None,
+                     stop_before_output: bool = False):
+        """Topological walk; returns (activations dict, new_state, masks).
+        ``stop_before_output=True`` leaves output-layer vertices at their
+        PRE-activation inputs (training path computes loss from logits)."""
+        acts: Dict[str, Any] = dict(inputs)
+        act_masks: Dict[str, Any] = dict(masks or {})
+        new_state = dict(state)
+        layer_names = [n for n, _ in self._layer_vertices()]
+        keys = (dict(zip(layer_names,
+                         jax.random.split(rng, max(len(layer_names), 1))))
+                if rng is not None else {})
+        out_set = set(self.conf.network_outputs) if stop_before_output else set()
+        for name in self.conf.topological_order:
+            spec = self.conf.vertices[name]
+            xs = [acts[i] for i in self.conf.vertex_inputs[name]]
+            in_masks = [m for i in self.conf.vertex_inputs[name]
+                        if (m := act_masks.get(i)) is not None]
+            # Combining vertices AND their input masks pointwise (DL4J
+            # feedForwardMaskArrays: a timestep is valid only if valid in
+            # every masked input).
+            mask = None
+            for m in in_masks:
+                mask = m if mask is None else jnp.minimum(mask, m)
+            if spec.layer is not None:
+                x = xs[0] if len(xs) == 1 else MergeVertex().apply(xs)
+                if spec.preprocessor is not None:
+                    x = spec.preprocessor(x)
+                if name in out_set:
+                    acts[name] = x  # hidden activation feeding the loss head
+                    continue
+                ly = spec.layer
+                kwargs = {"mask": mask} if getattr(ly, "USES_MASK", False) \
+                    else {}
+                y, s = ly.apply(params[name], state[name], x,
+                                training=training, rng=keys.get(name),
+                                compute_dtype=self._compute_dtype, **kwargs)
+                new_state[name] = s
+                acts[name] = y
+            else:
+                acts[name] = spec.vertex.apply(xs)
+            if mask is not None:
+                act_masks[name] = mask
+        return acts, new_state, act_masks
+
+    def _forward_infer(self, params, state, inputs, masks=None):
+        """Inference forward; returns dict of output-vertex activations."""
+        inputs = self._as_input_dict(inputs)
+        acts, _, _ = self._forward_all(params, state, inputs, False, None,
+                                       masks=masks)
+        return {n: acts[n] for n in self.conf.network_outputs}
+
+    def _regularization_score(self, params):
+        reg = 0.0
+        for name, ly in self._layer_vertices():
+            l1 = ly.l1 or 0.0
+            l2 = ly.l2 or 0.0
+            if not (l1 or l2):
+                continue
+            for pname in ly.regularized_param_names():
+                w = params[name].get(pname)
+                if w is None:
+                    continue
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(w))
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(jnp.square(w))
+        return reg
+
+    def _score_batch(self, params, state, batch, rng, training):
+        """Sum of per-output mean losses + regularization (DL4J
+        ``ComputationGraph.score``: output-layer scores summed)."""
+        inputs = self._as_input_dict(batch["features"])
+        labels = batch["labels"]
+        if not isinstance(labels, dict):
+            labels = {self.conf.network_outputs[0]: labels}
+        fmasks = batch.get("features_mask")
+        if fmasks is not None and not isinstance(fmasks, dict):
+            fmasks = {self.conf.network_inputs[0]: fmasks}
+        lmasks = batch.get("labels_mask")
+        if lmasks is None:
+            lmasks = {}
+        elif not isinstance(lmasks, dict):
+            lmasks = {self.conf.network_outputs[0]: lmasks}
+        acts, new_state, _ = self._forward_all(
+            params, state, inputs, training, rng, masks=fmasks,
+            stop_before_output=True)
+        loss = 0.0
+        for name in self.conf.network_outputs:
+            out_layer = self.conf.vertices[name].layer
+            if not isinstance(out_layer, BaseOutputLayerConf):
+                raise ValueError(
+                    f"Output vertex {name!r} must be an output/loss layer")
+            z = out_layer.pre_output(params[name], acts[name],
+                                     self._compute_dtype)
+            lmask = lmasks.get(name)
+            scores = out_layer.per_example_score(labels[name], z, lmask)
+            if lmask is not None:
+                loss = loss + jnp.sum(scores) / jnp.maximum(jnp.sum(lmask), 1.0)
+            else:
+                loss = loss + jnp.mean(scores)
+        return loss + self._regularization_score(params), new_state
+
+    def _score_batch_infer(self, params, state, batch):
+        loss, _ = self._score_batch(params, state, batch, None, False)
+        return loss
+
+    # ------------------------------------------------------------------
+    # Training
+    # ------------------------------------------------------------------
+    def _build_solver(self, alloc_opt_state: bool = True):
+        if self._solver is not None:
+            return
+        decay_tree = jax.tree_util.tree_map(lambda _: 0.0, self.params_tree)
+        any_decay = False
+        for name, ly in self._layer_vertices():
+            wd = ly.weight_decay or 0.0
+            if wd:
+                any_decay = True
+                for pname in ly.regularized_param_names():
+                    if pname in decay_tree[name]:
+                        decay_tree[name][pname] = wd
+        self._solver = Solver(
+            score_fn=self._score_batch,
+            updater=self._updater,
+            grad_normalization=self.conf.grad_normalization,
+            grad_norm_threshold=self.conf.grad_norm_threshold,
+            minimize=self.conf.global_conf.minimize,
+            decay_tree=decay_tree if any_decay else None,
+        )
+        if alloc_opt_state and self.opt_state is None:
+            self.opt_state = self._solver.init_opt_state(self.params_tree)
+
+    def _batch_dict(self, ds: Union[DataSet, MultiDataSet]):
+        def named(v, names):
+            """list/tuple → dict keyed positionally by input/output name."""
+            if v is None:
+                return None
+            if isinstance(v, dict):
+                return {k: jnp.asarray(a) for k, a in v.items()
+                        if a is not None}
+            if isinstance(v, (list, tuple)):
+                return {n: jnp.asarray(a) for n, a in zip(names, v)
+                        if a is not None}
+            return jnp.asarray(v)
+
+        ins = self.conf.network_inputs
+        outs = self.conf.network_outputs
+        b = {"features": named(ds.features, ins),
+             "labels": named(ds.labels, outs)}
+        fmask = getattr(ds, "features_mask",
+                        getattr(ds, "features_masks", None))
+        lmask = getattr(ds, "labels_mask", getattr(ds, "labels_masks", None))
+        fmask = named(fmask, ins)
+        lmask = named(lmask, outs)
+        if fmask is not None and (not isinstance(fmask, dict) or fmask):
+            b["features_mask"] = fmask
+        if lmask is not None and (not isinstance(lmask, dict) or lmask):
+            b["labels_mask"] = lmask
+        return b
+
+    def fit(self, data, n_epochs: int = 1, async_prefetch: bool = True):
+        """Train on a DataSet / MultiDataSet / iterator (DL4J
+        ``ComputationGraph.fit`` overloads)."""
+        self._check_init()
+        self._build_solver()
+        if isinstance(data, (DataSet, MultiDataSet)):
+            iterator: DataSetIterator = ListDataSetIterator([data])
+            async_prefetch = False
+        else:
+            iterator = data
+        wrapped = (AsyncDataSetIterator(iterator)
+                   if async_prefetch and not isinstance(
+                       iterator, AsyncDataSetIterator)
+                   else iterator)
+        last_loss = None
+        for _ in range(n_epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch_count)
+            for ds in wrapped:
+                batch = self._batch_dict(ds)
+                (self.params_tree, self.opt_state, self.state_tree,
+                 loss) = self._solver.step(
+                    self.params_tree, self.opt_state, self.state_tree,
+                    self.iteration_count, batch, self._rng.next_key())
+                last_loss = loss
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration_count,
+                                       self.epoch_count, loss)
+                self.iteration_count += 1
+            self.epoch_count += 1
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch_count - 1)
+            iterator.reset()
+        return None if last_loss is None else float(last_loss)
+
+    def compiled_train_step(self):
+        """A reusable jitted full train step operating on a ``TrainState``
+        — the benchmark/serving-loop entry (donated buffers, so params and
+        optimizer state update in place in HBM)."""
+        self._check_init()
+        self._build_solver(alloc_opt_state=False)
+        model = self
+
+        class _Step:
+            def init(self) -> TrainState:
+                # COPIES of the model trees: the step donates its buffers,
+                # so handing over the model's own arrays would leave the
+                # model holding deleted HBM buffers after the first call.
+                params = jax.tree_util.tree_map(jnp.copy, model.params_tree)
+                mstate = jax.tree_util.tree_map(jnp.copy, model.state_tree)
+                return TrainState(params,
+                                  model._solver.init_opt_state(params),
+                                  mstate,
+                                  jnp.zeros((), jnp.int32))
+
+            def __call__(self, st: TrainState, features, labels,
+                         features_mask=None, labels_mask=None):
+                batch = {"features": features, "labels": labels}
+                if features_mask is not None:
+                    batch["features_mask"] = features_mask
+                if labels_mask is not None:
+                    batch["labels_mask"] = labels_mask
+                params, opt_state, mstate, loss = model._solver.step(
+                    st.params, st.opt_state, st.model_state, st.step, batch,
+                    model._rng.next_key())
+                return TrainState(params, opt_state, mstate, st.step + 1), loss
+
+        return _Step()
+
+    # ------------------------------------------------------------------
+    # Inference / scoring
+    # ------------------------------------------------------------------
+    def output(self, *inputs, training: bool = False, features_mask=None):
+        """Forward pass (DL4J ``ComputationGraph.output(INDArray...)``).
+        Returns a single array for single-output nets, else a list in
+        ``network_outputs`` order."""
+        self._check_init()
+        if len(inputs) == 1:
+            x = inputs[0]
+        else:
+            x = list(inputs)
+        ins = {k: jnp.asarray(v)
+               for k, v in self._as_input_dict(x).items()}
+        masks = None
+        if features_mask is not None:
+            masks = {k: jnp.asarray(v) for k, v in
+                     self._as_input_dict(features_mask).items()}
+        if training:
+            acts, _, _ = self._forward_all(
+                self.params_tree, self.state_tree, ins, True,
+                self._rng.next_key(), masks=masks)
+            outs = {n: acts[n] for n in self.conf.network_outputs}
+        else:
+            outs = self._output_fn(self.params_tree, self.state_tree, ins,
+                                   masks)
+        vals = [outs[n] for n in self.conf.network_outputs]
+        return vals[0] if len(vals) == 1 else vals
+
+    def feed_forward(self, inputs, training: bool = False) -> Dict[str, Any]:
+        """All vertex activations by name (DL4J ``feedForward``)."""
+        self._check_init()
+        ins = {k: jnp.asarray(v)
+               for k, v in self._as_input_dict(inputs).items()}
+        rng = self._rng.next_key() if training else None
+        acts, _, _ = self._forward_all(self.params_tree, self.state_tree,
+                                       ins, training, rng)
+        return acts
+
+    def score(self, ds: Union[DataSet, MultiDataSet]) -> float:
+        self._check_init()
+        return float(self._score_fn(self.params_tree, self.state_tree,
+                                    self._batch_dict(ds)))
+
+    def evaluate(self, iterator: DataSetIterator, top_n: int = 1) -> Evaluation:
+        """Single-output classification eval (DL4J ``evaluate``)."""
+        self._check_init()
+        ev = Evaluation(top_n=top_n)
+        for ds in iterator:
+            out = self.output(ds.features,
+                              features_mask=ds.features_mask)
+            ev.eval(ds.labels, np.asarray(out), ds.labels_mask)
+        iterator.reset()
+        return ev
+
+    def evaluate_regression(self, iterator) -> RegressionEvaluation:
+        self._check_init()
+        ev = RegressionEvaluation()
+        for ds in iterator:
+            ev.eval(ds.labels, np.asarray(self.output(ds.features)),
+                    ds.labels_mask)
+        iterator.reset()
+        return ev
+
+    def evaluate_roc(self, iterator, exact: bool = True) -> ROCMultiClass:
+        self._check_init()
+        roc = ROCMultiClass(exact=exact)
+        for ds in iterator:
+            roc.eval(ds.labels, np.asarray(self.output(ds.features)),
+                     ds.labels_mask)
+        iterator.reset()
+        return roc
+
+    # ------------------------------------------------------------------
+    # Parameter access
+    # ------------------------------------------------------------------
+    def _leaf_order(self):
+        for name in self.conf.topological_order:
+            lp = self.params_tree.get(name, {})
+            for pname in sorted(lp.keys()):
+                yield name, pname
+
+    def params(self) -> np.ndarray:
+        self._check_init()
+        parts = [np.asarray(self.params_tree[v][n]).reshape(-1)
+                 for v, n in self._leaf_order()]
+        return (np.concatenate(parts) if parts
+                else np.zeros((0,), np.float32))
+
+    def set_params(self, vector: np.ndarray):
+        self._check_init()
+        vector = np.asarray(vector)
+        off = 0
+        new = {k: dict(v) for k, v in self.params_tree.items()}
+        for v, n in self._leaf_order():
+            arr = self.params_tree[v][n]
+            size = int(np.prod(arr.shape)) if arr.shape else 1
+            new[v][n] = jnp.asarray(
+                vector[off:off + size].reshape(arr.shape), arr.dtype)
+            off += size
+        if off != vector.size:
+            raise ValueError(f"Expected {off} values, got {vector.size}")
+        self.params_tree = new
+
+    def num_params(self) -> int:
+        self._check_init()
+        return sum(int(np.prod(np.asarray(l).shape))
+                   for l in jax.tree_util.tree_leaves(self.params_tree))
+
+    # ------------------------------------------------------------------
+    def set_listeners(self, *listeners: TrainingListener):
+        self.listeners = list(listeners)
+
+    def add_listeners(self, *listeners: TrainingListener):
+        self.listeners.extend(listeners)
+
+    def clone(self) -> "ComputationGraph":
+        m = ComputationGraph(ComputationGraphConfiguration.from_dict(
+            self.conf.to_dict()))
+        if self.params_tree is not None:
+            m.params_tree = jax.tree_util.tree_map(lambda a: a,
+                                                   self.params_tree)
+            m.state_tree = jax.tree_util.tree_map(lambda a: a,
+                                                  self.state_tree)
+        m.iteration_count = self.iteration_count
+        m.epoch_count = self.epoch_count
+        return m
+
+    def summary(self) -> str:
+        self._check_init()
+        rows = [f"{'name':<28} {'type':<26} {'inputs':<30} {'#params':>10}"]
+        total = 0
+        for name in self.conf.topological_order:
+            spec = self.conf.vertices[name]
+            kind = (type(spec.layer).__name__ if spec.layer is not None
+                    else type(spec.vertex).__name__)
+            lp = self.params_tree.get(name, {})
+            n = sum(int(np.prod(np.asarray(a).shape)) for a in lp.values())
+            total += n
+            ins = ",".join(self.conf.vertex_inputs[name])
+            rows.append(f"{name:<28} {kind:<26} {ins:<30} {n:>10}")
+        rows.append(f"Total params: {total}")
+        return "\n".join(rows)
+
+    def save(self, path, save_updater: bool = True):
+        from deeplearning4j_tpu.utils.model_serializer import write_model
+        write_model(self, path, save_updater=save_updater)
+
+    @staticmethod
+    def load(path, load_updater: bool = True) -> "ComputationGraph":
+        from deeplearning4j_tpu.utils.model_serializer import (
+            restore_computation_graph)
+        return restore_computation_graph(path, load_updater=load_updater)

@@ -51,6 +51,9 @@ class MultiLayerNetwork:
         self.last_batch_size = 0
         self._rng = RngKeyManager(conf.global_conf.seed)
         self._dtype = canonical_dtype(conf.global_conf.dtype)
+        cd = getattr(conf.global_conf, "compute_dtype", None)
+        self._compute_dtype = (canonical_dtype(cd) if cd
+                               else backend().compute_dtype)
         self._updater = updater_from_dict(conf.global_conf.updater)
         self._solver: Optional[Solver] = None
         self._output_fn = jax.jit(self._forward_infer)
@@ -87,7 +90,7 @@ class MultiLayerNetwork:
         `mask` is the features mask ([b, t] for sequences) handed to
         mask-aware layers (``USES_MASK``) — DL4J's setMaskArray propagation.
         """
-        compute_dtype = backend().compute_dtype
+        compute_dtype = self._compute_dtype
         n = len(self.layers) if upto is None else upto
         keys = (jax.random.split(rng, n) if rng is not None
                 else [None] * n)
@@ -146,7 +149,7 @@ class MultiLayerNetwork:
             h = pre(h)
         z = out_layer.pre_output(
             params[f"layer_{len(self.layers) - 1}"], h,
-            backend().compute_dtype)
+            self._compute_dtype)
         scores = out_layer.per_example_score(labels, z, lmask)
         if lmask is not None:
             denom = jnp.maximum(jnp.sum(lmask), 1.0)
@@ -260,7 +263,7 @@ class MultiLayerNetwork:
         self._check_init()
         x = jnp.asarray(x)
         acts = [x]
-        compute_dtype = backend().compute_dtype
+        compute_dtype = self._compute_dtype
         rng = self._rng.next_key() if training else None
         keys = (jax.random.split(rng, len(self.layers)) if rng is not None
                 else [None] * len(self.layers))

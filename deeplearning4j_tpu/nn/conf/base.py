@@ -129,4 +129,7 @@ class GlobalConf:
     dropout: float = 0.0
     updater: Optional[dict] = None
     dtype: str = "float32"
+    # Matmul/conv compute dtype; None = backend default.  "bfloat16" with
+    # f32 params is the TPU-native training recipe (full-rate MXU).
+    compute_dtype: Optional[str] = None
     minimize: bool = True

@@ -70,6 +70,12 @@ class Builder:
         self._g.dtype = str(d)
         return self
 
+    def compute_dtype(self, d: str) -> "Builder":
+        """Matmul/conv compute dtype ('bfloat16' feeds the MXU at full
+        rate; params stay in ``dtype``)."""
+        self._g.compute_dtype = str(d)
+        return self
+
     def minimize(self, m: bool = True) -> "Builder":
         self._g.minimize = bool(m)
         return self

@@ -33,6 +33,10 @@ _TRAINING = "training.json"
 def _flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:
     out = {}
     if isinstance(tree, dict):
+        if not tree and prefix:
+            # Keep empty subtrees (paramless vertices) so the restored
+            # structure matches params exactly — updater trees require it.
+            out[prefix + "@empty"] = np.zeros(0, np.float32)
         for k in sorted(tree.keys()):
             out.update(_flatten_tree(tree[k], f"{prefix}{k}/"))
     elif isinstance(tree, (list, tuple)):
@@ -53,6 +57,8 @@ def _unflatten_tree(flat: Dict[str, np.ndarray]):
         node = root
         for p in parts[:-1]:
             node = node.setdefault(p, {})
+        if parts[-1] == "@empty":
+            continue  # marker: parent dict exists but is empty
         node[parts[-1]] = jnp.asarray(val)
 
     def listify(node):

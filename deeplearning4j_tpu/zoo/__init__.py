@@ -1,0 +1,16 @@
+"""Model zoo (``deeplearning4j/deeplearning4j-zoo``).
+
+Each zoo class mirrors a DL4J ``org.deeplearning4j.zoo.model.*`` builder:
+a named architecture with the reference hyperparameters, constructed on the
+framework's own config system (GraphBuilder / ListBuilder) — so every zoo
+model is also a round-trippable JSON config, exactly like upstream.
+"""
+from deeplearning4j_tpu.zoo.base import ZooModel
+from deeplearning4j_tpu.zoo.lenet import LeNet
+from deeplearning4j_tpu.zoo.alexnet import AlexNet
+from deeplearning4j_tpu.zoo.vgg import VGG16, VGG19
+from deeplearning4j_tpu.zoo.resnet import ResNet50
+from deeplearning4j_tpu.zoo.simple_cnn import SimpleCNN
+
+__all__ = ["ZooModel", "LeNet", "AlexNet", "VGG16", "VGG19", "ResNet50",
+           "SimpleCNN"]

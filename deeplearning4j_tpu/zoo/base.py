@@ -1,0 +1,45 @@
+"""ZooModel base (``org.deeplearning4j.zoo.ZooModel`` /
+``org.deeplearning4j.zoo.Model``).
+
+Upstream a ZooModel can also download pretrained weights by URL+checksum;
+this environment has no egress, so ``init_pretrained`` loads from a local
+checkpoint path instead (same semantic: architecture + weights).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class ZooModel:
+    n_classes: int = 1000
+    seed: int = 123
+    input_shape: Tuple[int, int, int] = (224, 224, 3)  # NHWC (DL4J: CHW)
+
+    def conf(self):
+        """Build the model configuration (graph or multi-layer)."""
+        raise NotImplementedError
+
+    def init_graph(self):
+        """Construct + initialize the model (DL4J ``ZooModel.init()``)."""
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        from deeplearning4j_tpu.models.multi_layer_network import (
+            MultiLayerNetwork)
+
+        c = self.conf()
+        if isinstance(c, ComputationGraphConfiguration):
+            return ComputationGraph(c).init()
+        assert isinstance(c, MultiLayerConfiguration)
+        return MultiLayerNetwork(c).init()
+
+    # DL4J initPretrained(PretrainedType) — local checkpoint stand-in
+    def init_pretrained(self, checkpoint_path: str):
+        from deeplearning4j_tpu.utils.model_serializer import (
+            restore_computation_graph, restore_multi_layer_network)
+        try:
+            return restore_computation_graph(checkpoint_path)
+        except Exception:
+            return restore_multi_layer_network(checkpoint_path)

@@ -1,0 +1,95 @@
+"""ResNet-50 (``org.deeplearning4j.zoo.model.ResNet50``).
+
+The baseline flagship: ComputationGraph with bottleneck residual blocks
+(conv/identity shortcut via ``ElementWiseVertex("add")``), structure
+[3, 4, 6, 3], exactly the upstream zoo topology (which mirrors Keras
+ResNet50 v1: zero-pad 3 → conv7x7/2 → bn → relu → maxpool3x3/2 →
+4 stages → avgpool → dense softmax).
+
+TPU-first defaults: NHWC layout, f32 params with bf16 matmul/conv compute
+(full-rate MXU), one jitted train step.  DL4J's default updater for this
+model is AdaDelta — kept for parity.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers_conv import (
+    BatchNormalization, ConvolutionLayer, SubsamplingLayer, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.conf.layers_core import ActivationLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import AdaDelta
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+@dataclasses.dataclass
+class ResNet50(ZooModel):
+    updater: object = None
+    compute_dtype: str = "bfloat16"
+
+    def _conv_bn_relu(self, g, name, inp, n_out, kernel, stride, relu=True,
+                      mode="truncate", padding=(0, 0)):
+        g.add_layer(name, ConvolutionLayer(
+            kernel_size=kernel, stride=stride, padding=padding,
+            convolution_mode=mode, n_out=n_out, activation="identity"), inp)
+        g.add_layer(f"{name}_bn", BatchNormalization(), name)
+        if not relu:
+            return f"{name}_bn"
+        g.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+                    f"{name}_bn")
+        return f"{name}_relu"
+
+    def _bottleneck(self, g, stage, block, inp, filters, stride):
+        """One bottleneck unit.  ``stride`` > 1 (or a channel change) makes
+        this a conv block (projection shortcut); else identity shortcut."""
+        f1, f2, f3 = filters
+        base = f"s{stage}b{block}"
+        a = self._conv_bn_relu(g, f"{base}_a", inp, f1, (1, 1), stride)
+        b = self._conv_bn_relu(g, f"{base}_b", a, f2, (3, 3), (1, 1),
+                               mode="same")
+        c = self._conv_bn_relu(g, f"{base}_c", b, f3, (1, 1), (1, 1),
+                               relu=False)
+        if block == 0:
+            shortcut = self._conv_bn_relu(
+                g, f"{base}_sc", inp, f3, (1, 1), stride, relu=False)
+        else:
+            shortcut = inp
+        g.add_vertex(f"{base}_add", ElementWiseVertex("add"), c, shortcut)
+        g.add_layer(f"{base}_out", ActivationLayer(activation="relu"),
+                    f"{base}_add")
+        return f"{base}_out"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(self.updater or AdaDelta())
+             .compute_dtype(self.compute_dtype)
+             .weight_init("xavier"))
+        g = (b.graph()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        g.add_layer("pad1", ZeroPaddingLayer(padding=(3, 3)), "input")
+        stem = self._conv_bn_relu(g, "conv1", "pad1", 64, (7, 7), (2, 2))
+        g.add_layer("pool1", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), pooling_type="max",
+            convolution_mode="same"), stem)
+        x = "pool1"
+        stages = [
+            (2, [64, 64, 256], 3, (1, 1)),
+            (3, [128, 128, 512], 4, (2, 2)),
+            (4, [256, 256, 1024], 6, (2, 2)),
+            (5, [512, 512, 2048], 3, (2, 2)),
+        ]
+        for stage, filters, blocks, stride in stages:
+            for blk in range(blocks):
+                x = self._bottleneck(g, stage, blk, x, filters,
+                                     stride if blk == 0 else (1, 1))
+        g.add_layer("avgpool", SubsamplingLayer(
+            kernel_size=(7, 7), stride=(7, 7), pooling_type="avg"), x)
+        g.add_layer("output", OutputLayer(
+            n_out=self.n_classes, activation="softmax", loss="mcxent"),
+            "avgpool")
+        return g.set_outputs("output").build()

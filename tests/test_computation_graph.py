@@ -1,0 +1,201 @@
+"""ComputationGraph: DAG topology, vertices, multi-in/out, training,
+serialization — parity with upstream ComputationGraph tests
+(``deeplearning4j-core .../graph/TestComputationGraphNetwork.java``)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import ComputationGraph, NeuralNetConfiguration
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.models.computation_graph import (
+    ComputationGraphConfiguration)
+from deeplearning4j_tpu.nn.conf.graph_vertices import (
+    ElementWiseVertex, L2NormalizeVertex, MergeVertex, ReshapeVertex,
+    ScaleVertex, ShiftVertex, StackVertex, SubsetVertex, UnstackVertex)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers_core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+
+def _simple_graph(seed=12):
+    return (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=1e-2))
+            .graph()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(8))
+            .add_layer("d1", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("d2", DenseLayer(n_out=16, activation="relu"), "d1")
+            .add_vertex("res", ElementWiseVertex("add"), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "res")
+            .set_outputs("out")
+            .build())
+
+
+def _xy(rng, n=32, n_in=8, n_out=3):
+    x = rng.normal(size=(n, n_in)).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, n)]
+    return x, y
+
+
+def test_topology_and_shapes(rng):
+    conf = _simple_graph()
+    model = ComputationGraph(conf).init()
+    x, _ = _xy(rng)
+    out = model.output(x)
+    assert out.shape == (32, 3)
+    assert np.allclose(np.asarray(out).sum(1), 1.0, atol=1e-5)
+    # n_in auto-filled by shape propagation
+    assert conf.vertices["d1"].layer.n_in == 8
+    assert conf.vertices["out"].layer.n_in == 16
+
+
+def test_residual_add_matches_manual(rng):
+    model = ComputationGraph(_simple_graph()).init()
+    x, _ = _xy(rng, n=4)
+    acts = model.feed_forward(x)
+    assert np.allclose(np.asarray(acts["res"]),
+                       np.asarray(acts["d1"]) + np.asarray(acts["d2"]),
+                       atol=1e-6)
+
+
+def test_training_reduces_loss(rng):
+    model = ComputationGraph(_simple_graph()).init()
+    x, y = _xy(rng, n=128)
+    ds = DataSet(x, y)
+    before = model.score(ds)
+    for _ in range(60):
+        model.fit(ds)
+    after = model.score(ds)
+    assert after < before * 0.7
+    assert model.iteration_count == 60
+
+
+def test_multi_input_multi_output(rng):
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(Adam(learning_rate=1e-2))
+            .graph()
+            .add_inputs("a", "b")
+            .set_input_types(InputType.feed_forward(4),
+                             InputType.feed_forward(6))
+            .add_layer("da", DenseLayer(n_out=8, activation="tanh"), "a")
+            .add_layer("db", DenseLayer(n_out=8, activation="tanh"), "b")
+            .add_vertex("merged", MergeVertex(), "da", "db")
+            .add_layer("out1", OutputLayer(n_out=2, activation="softmax",
+                                           loss="mcxent"), "merged")
+            .add_layer("out2", OutputLayer(n_out=1, activation="identity",
+                                           loss="mse"), "merged")
+            .set_outputs("out1", "out2")
+            .build())
+    model = ComputationGraph(conf).init()
+    # merged concat: 8 + 8 = 16
+    assert conf.vertices["out1"].layer.n_in == 16
+    xa = rng.normal(size=(16, 4)).astype(np.float32)
+    xb = rng.normal(size=(16, 6)).astype(np.float32)
+    y1 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    y2 = rng.normal(size=(16, 1)).astype(np.float32)
+    o1, o2 = model.output(xa, xb)
+    assert o1.shape == (16, 2) and o2.shape == (16, 1)
+    mds = MultiDataSet([xa, xb], [y1, y2])
+    before = model.score(mds)
+    for _ in range(40):
+        model.fit(mds)
+    assert model.score(mds) < before
+
+
+def test_implicit_merge_on_multi_input_layer(rng):
+    """DL4J: a layer with several inputs gets an implicit MergeVertex."""
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(Sgd(learning_rate=0.1))
+            .graph()
+            .add_inputs("a", "b")
+            .set_input_types(InputType.feed_forward(3),
+                             InputType.feed_forward(5))
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"), "a", "b")
+            .set_outputs("out")
+            .build())
+    assert conf.vertices["out"].layer.n_in == 8
+    model = ComputationGraph(conf).init()
+    o = model.output(rng.normal(size=(4, 3)).astype(np.float32),
+                     rng.normal(size=(4, 5)).astype(np.float32))
+    assert o.shape == (4, 2)
+
+
+def test_vertices_math(rng):
+    x = rng.normal(size=(6, 4)).astype(np.float32)
+    assert np.allclose(ScaleVertex(2.5).apply([x]), x * 2.5)
+    assert np.allclose(ShiftVertex(1.5).apply([x]), x + 1.5)
+    assert np.allclose(SubsetVertex(1, 2).apply([x]), x[:, 1:3])
+    assert np.allclose(ElementWiseVertex("max").apply([x, -x]), np.abs(x))
+    assert np.allclose(ElementWiseVertex("average").apply([x, 3 * x]), 2 * x)
+    assert np.allclose(ElementWiseVertex("subtract").apply([x, x]), 0 * x)
+    assert np.allclose(ElementWiseVertex("product").apply([x, x]), x * x)
+    stacked = StackVertex().apply([x, 2 * x])
+    assert stacked.shape == (12, 4)
+    assert np.allclose(UnstackVertex(1, 2).apply([stacked]), 2 * x)
+    n = np.asarray(L2NormalizeVertex().apply([x]))
+    assert np.allclose(np.linalg.norm(n, axis=1), 1.0, atol=1e-4)
+    r = ReshapeVertex(new_shape=(2, 2)).apply([x])
+    assert r.shape == (6, 2, 2)
+
+
+def test_graph_cycle_detection():
+    gb = (NeuralNetConfiguration.builder()
+          .graph()
+          .add_inputs("in")
+          .set_input_types(InputType.feed_forward(4)))
+    gb.add_layer("a", DenseLayer(n_out=4), "in", "b")
+    gb.add_layer("b", DenseLayer(n_out=4), "a")
+    gb.set_outputs("b")
+    with pytest.raises(ValueError, match="cycle"):
+        gb.build()
+
+
+def test_json_round_trip(rng):
+    conf = _simple_graph()
+    s = conf.to_json()
+    conf2 = ComputationGraphConfiguration.from_json(s)
+    m1 = ComputationGraph(conf).init(seed=9)
+    m2 = ComputationGraph(conf2).init(seed=9)
+    x, _ = _xy(rng, n=4)
+    assert np.allclose(np.asarray(m1.output(x)), np.asarray(m2.output(x)),
+                       atol=1e-6)
+
+
+def test_serialization_round_trip(tmp_path, rng):
+    model = ComputationGraph(_simple_graph()).init()
+    x, y = _xy(rng, n=16)
+    ds = DataSet(x, y)
+    model.fit(ds)
+    p = tmp_path / "graph.zip"
+    model.save(p)
+    restored = ComputationGraph.load(p)
+    assert np.allclose(np.asarray(model.output(x)),
+                       np.asarray(restored.output(x)), atol=1e-6)
+    assert restored.iteration_count == model.iteration_count
+    # training continues from restored updater state without blowup
+    restored.fit(ds)
+
+
+def test_params_vector_round_trip(rng):
+    model = ComputationGraph(_simple_graph()).init()
+    v = model.params()
+    assert v.size == model.num_params()
+    model2 = ComputationGraph(_simple_graph()).init(seed=99)
+    model2.set_params(v)
+    x, _ = _xy(rng, n=4)
+    assert np.allclose(np.asarray(model.output(x)),
+                       np.asarray(model2.output(x)), atol=1e-6)
+
+
+def test_compiled_train_step(rng):
+    model = ComputationGraph(_simple_graph()).init()
+    step = model.compiled_train_step()
+    st = step.init()
+    x, y = _xy(rng, n=64)
+    losses = []
+    for _ in range(30):
+        st, loss = step(st, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert int(st.step) == 30

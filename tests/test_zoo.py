@@ -1,0 +1,69 @@
+"""Model zoo: builders produce runnable models with the reference
+topologies/parameter counts (``deeplearning4j-zoo .../TestInstantiation``)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo import (
+    AlexNet, LeNet, ResNet50, SimpleCNN, VGG16, VGG19)
+
+
+def test_lenet_runs(rng):
+    model = LeNet(n_classes=10).init_graph()
+    x = rng.normal(size=(4, 28, 28, 1)).astype(np.float32)
+    out = model.output(x)
+    assert out.shape == (4, 10)
+    assert np.allclose(np.asarray(out).sum(1), 1.0, atol=1e-5)
+
+
+def test_simple_cnn_runs(rng):
+    model = SimpleCNN(n_classes=5).init_graph()
+    x = rng.normal(size=(2, 48, 48, 3)).astype(np.float32)
+    assert model.output(x).shape == (2, 5)
+
+
+@pytest.mark.slow
+def test_alexnet_runs(rng):
+    model = AlexNet(n_classes=100).init_graph()
+    x = rng.normal(size=(2, 224, 224, 3)).astype(np.float32)
+    assert model.output(x).shape == (2, 100)
+
+
+def test_resnet50_topology():
+    """Param count must match the canonical ResNet-50 v1 (torchvision /
+    Keras): 25,583,592 trainable + 53,120 BN running stats ≈ 25.6M."""
+    model = ResNet50(n_classes=1000).init_graph()
+    n = model.num_params()
+    assert abs(n - 25_583_592) / 25_583_592 < 0.02, n
+    # 16 bottleneck blocks -> 16 residual adds
+    adds = [v for v in model.vertex_names() if v.endswith("_add")]
+    assert len(adds) == 16
+
+
+@pytest.mark.slow
+def test_resnet50_forward_and_step(rng):
+    model = ResNet50(n_classes=4).init_graph()
+    x = rng.normal(size=(2, 224, 224, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 2)]
+    step = model.compiled_train_step()
+    st = step.init()
+    st, loss = step(st, x, y)
+    assert np.isfinite(float(loss))
+    # the model's own buffers survive the donating step
+    assert model.output(x).shape == (2, 4)
+
+
+def test_vgg16_topology():
+    model = VGG16(n_classes=1000).init_graph()
+    # canonical VGG16: 138,357,544 params
+    assert abs(model.num_params() - 138_357_544) < 1000
+
+
+@pytest.mark.slow
+def test_vgg19_builds():
+    conf = VGG19(n_classes=10).conf()
+    # 19 weight layers: 16 convs + 3 dense
+    from deeplearning4j_tpu.nn.conf.layers_conv import ConvolutionLayer
+    from deeplearning4j_tpu.nn.conf.layers_core import DenseLayer
+    convs = [l for l in conf.layers if isinstance(l, ConvolutionLayer)]
+    dense = [l for l in conf.layers if isinstance(l, DenseLayer)]
+    assert len(convs) == 16 and len(dense) == 3
