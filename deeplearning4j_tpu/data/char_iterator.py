@@ -1,0 +1,91 @@
+"""Character iterator for char-RNN language modelling.
+
+Parity with the dl4j-examples ``CharacterIterator`` used by
+``LSTMCharModellingExample`` (the GravesLSTM char-RNN baseline config in
+BASELINE.json): one-hot [b, t, vocab] features, labels = next character,
+random example offsets per epoch.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import DataSetIterator
+
+
+class CharacterIterator(DataSetIterator):
+    def __init__(self, text: str, seq_length: int = 64, batch: int = 32,
+                 valid_chars: Optional[Sequence[str]] = None,
+                 seed: int = 12345):
+        if valid_chars is None:
+            valid_chars = sorted(set(text))
+        self.chars: List[str] = list(valid_chars)
+        self.char_to_idx = {c: i for i, c in enumerate(self.chars)}
+        self.data = np.asarray(
+            [self.char_to_idx[c] for c in text if c in self.char_to_idx],
+            np.int32)
+        if len(self.data) <= seq_length + 1:
+            raise ValueError("Text shorter than one sequence")
+        self.seq_length = seq_length
+        self.batch = batch
+        self._rng = np.random.default_rng(seed)
+        self._seed = seed
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.chars)
+
+    def total_outcomes(self):
+        return self.vocab_size
+
+    def batch_size(self):
+        return self.batch
+
+    def __iter__(self):
+        n_examples = (len(self.data) - 1) // self.seq_length
+        starts = self._rng.permutation(n_examples) * self.seq_length
+        eye = np.eye(self.vocab_size, dtype=np.float32)
+        for i in range(0, len(starts) - self.batch + 1, self.batch):
+            xs, ys = [], []
+            for s in starts[i:i + self.batch]:
+                window = self.data[s:s + self.seq_length + 1]
+                xs.append(eye[window[:-1]])
+                ys.append(eye[window[1:]])
+            yield DataSet(np.stack(xs), np.stack(ys))
+
+    def reset(self):
+        # Keep the RNG rolling: each epoch draws a FRESH permutation of
+        # example offsets (dl4j-examples CharacterIterator reshuffles on
+        # reset; re-seeding here would replay epoch 1's order forever).
+        pass
+
+    def encode(self, s: str) -> np.ndarray:
+        eye = np.eye(self.vocab_size, dtype=np.float32)
+        return eye[[self.char_to_idx[c] for c in s]][None]
+
+    def decode(self, indices) -> str:
+        return "".join(self.chars[int(i)] for i in np.asarray(indices))
+
+
+def sample_characters(model, iterator: CharacterIterator, init: str,
+                      n_chars: int = 200, temperature: float = 1.0,
+                      seed: int = 0) -> str:
+    """Generate text with ``rnn_time_step`` (the dl4j-examples
+    ``sampleCharactersFromNetwork`` loop: prime with `init`, then feed each
+    sampled char back one step at a time)."""
+    rng = np.random.default_rng(seed)
+    model.rnn_clear_previous_state()
+    probs = np.asarray(model.rnn_time_step(iterator.encode(init)))[0, -1]
+    out = list(init)
+    eye = np.eye(iterator.vocab_size, dtype=np.float32)
+    for _ in range(n_chars):
+        logits = np.log(np.maximum(probs, 1e-12)) / temperature
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        idx = int(rng.choice(iterator.vocab_size, p=p))
+        out.append(iterator.chars[idx])
+        probs = np.asarray(model.rnn_time_step(eye[idx][None]))[0]
+    model.rnn_clear_previous_state()
+    return "".join(out)

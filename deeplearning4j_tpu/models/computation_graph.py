@@ -426,6 +426,7 @@ class ComputationGraph:
         return {n: acts[n] for n in self.conf.network_outputs}
 
     def _regularization_score(self, params):
+        from deeplearning4j_tpu.utils.trees import get_path
         reg = 0.0
         for name, ly in self._layer_vertices():
             l1 = ly.l1 or 0.0
@@ -433,7 +434,7 @@ class ComputationGraph:
             if not (l1 or l2):
                 continue
             for pname in ly.regularized_param_names():
-                w = params[name].get(pname)
+                w = get_path(params[name], pname)
                 if w is None:
                     continue
                 if l1:
@@ -486,6 +487,7 @@ class ComputationGraph:
     def _build_solver(self, alloc_opt_state: bool = True):
         if self._solver is not None:
             return
+        from deeplearning4j_tpu.utils.trees import get_path, set_path
         decay_tree = jax.tree_util.tree_map(lambda _: 0.0, self.params_tree)
         any_decay = False
         for name, ly in self._layer_vertices():
@@ -493,8 +495,8 @@ class ComputationGraph:
             if wd:
                 any_decay = True
                 for pname in ly.regularized_param_names():
-                    if pname in decay_tree[name]:
-                        decay_tree[name][pname] = wd
+                    if get_path(decay_tree[name], pname) is not None:
+                        set_path(decay_tree[name], pname, wd)
         self._solver = Solver(
             score_fn=self._score_batch,
             updater=self._updater,
@@ -563,6 +565,8 @@ class ComputationGraph:
                     lst.iteration_done(self, self.iteration_count,
                                        self.epoch_count, loss)
                 self.iteration_count += 1
+                if self._has_rnn():
+                    self.rnn_clear_previous_state()
             self.epoch_count += 1
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch_count - 1)
@@ -602,6 +606,18 @@ class ComputationGraph:
                 return TrainState(params, opt_state, mstate, st.step + 1), loss
 
         return _Step()
+
+    # ------------------------------------------------------------------
+    # Recurrent state (DL4J ComputationGraph.rnnTimeStep analogues)
+    # ------------------------------------------------------------------
+    def _has_rnn(self) -> bool:
+        return any(getattr(ly, "IS_RNN", False)
+                   for _, ly in self._layer_vertices())
+
+    def rnn_clear_previous_state(self):
+        from deeplearning4j_tpu.nn.conf.layers_recurrent import strip_rnn_carry
+        if self.state_tree is not None:
+            self.state_tree = strip_rnn_carry(self.state_tree)
 
     # ------------------------------------------------------------------
     # Inference / scoring
@@ -680,28 +696,28 @@ class ComputationGraph:
     # Parameter access
     # ------------------------------------------------------------------
     def _leaf_order(self):
+        from deeplearning4j_tpu.utils.trees import iter_leaves
         for name in self.conf.topological_order:
-            lp = self.params_tree.get(name, {})
-            for pname in sorted(lp.keys()):
-                yield name, pname
+            for path, leaf in iter_leaves(self.params_tree.get(name, {})):
+                yield (name,) + path, leaf
 
     def params(self) -> np.ndarray:
         self._check_init()
-        parts = [np.asarray(self.params_tree[v][n]).reshape(-1)
-                 for v, n in self._leaf_order()]
+        parts = [np.asarray(leaf).reshape(-1)
+                 for _, leaf in self._leaf_order()]
         return (np.concatenate(parts) if parts
                 else np.zeros((0,), np.float32))
 
     def set_params(self, vector: np.ndarray):
+        from deeplearning4j_tpu.utils.trees import deep_copy_dicts, set_path
         self._check_init()
         vector = np.asarray(vector)
         off = 0
-        new = {k: dict(v) for k, v in self.params_tree.items()}
-        for v, n in self._leaf_order():
-            arr = self.params_tree[v][n]
+        new = deep_copy_dicts(self.params_tree)
+        for path, arr in self._leaf_order():
             size = int(np.prod(arr.shape)) if arr.shape else 1
-            new[v][n] = jnp.asarray(
-                vector[off:off + size].reshape(arr.shape), arr.dtype)
+            set_path(new, path, jnp.asarray(
+                vector[off:off + size].reshape(arr.shape), arr.dtype))
             off += size
         if off != vector.size:
             raise ValueError(f"Expected {off} values, got {vector.size}")
@@ -732,6 +748,7 @@ class ComputationGraph:
         return m
 
     def summary(self) -> str:
+        from deeplearning4j_tpu.utils.trees import iter_leaves
         self._check_init()
         rows = [f"{'name':<28} {'type':<26} {'inputs':<30} {'#params':>10}"]
         total = 0
@@ -740,7 +757,8 @@ class ComputationGraph:
             kind = (type(spec.layer).__name__ if spec.layer is not None
                     else type(spec.vertex).__name__)
             lp = self.params_tree.get(name, {})
-            n = sum(int(np.prod(np.asarray(a).shape)) for a in lp.values())
+            n = sum(int(np.prod(np.asarray(a).shape))
+                    for _, a in iter_leaves(lp))
             total += n
             ins = ",".join(self.conf.vertex_inputs[name])
             rows.append(f"{name:<28} {kind:<26} {ins:<30} {n:>10}")

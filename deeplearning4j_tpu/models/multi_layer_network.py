@@ -115,6 +115,7 @@ class MultiLayerNetwork:
         return y
 
     def _regularization_score(self, params):
+        from deeplearning4j_tpu.utils.trees import get_path
         reg = 0.0
         for i, ly in enumerate(self.layers):
             l1 = ly.l1 or 0.0
@@ -122,7 +123,7 @@ class MultiLayerNetwork:
             if not (l1 or l2):
                 continue
             for name in ly.regularized_param_names():
-                w = params[f"layer_{i}"].get(name)
+                w = get_path(params[f"layer_{i}"], name)
                 if w is None:
                     continue
                 if l1:
@@ -168,6 +169,7 @@ class MultiLayerNetwork:
     def _build_solver(self):
         if self._solver is not None:
             return
+        from deeplearning4j_tpu.utils.trees import get_path, set_path
         decay_tree = jax.tree_util.tree_map(lambda _: 0.0, self.params_tree)
         any_decay = False
         for i, ly in enumerate(self.layers):
@@ -175,8 +177,8 @@ class MultiLayerNetwork:
             if wd:
                 any_decay = True
                 for name in ly.regularized_param_names():
-                    if name in decay_tree[f"layer_{i}"]:
-                        decay_tree[f"layer_{i}"][name] = wd
+                    if get_path(decay_tree[f"layer_{i}"], name) is not None:
+                        set_path(decay_tree[f"layer_{i}"], name, wd)
         self._solver = Solver(
             score_fn=self._score_batch,
             updater=self._updater,
@@ -216,22 +218,32 @@ class MultiLayerNetwork:
                    if async_prefetch and not isinstance(
                        iterator, AsyncDataSetIterator)
                    else iterator)
+        tbptt = (self.conf.backprop_type == "truncated_bptt"
+                 and self.conf.tbptt_fwd_length)
         last_loss = None
         for _ in range(n_epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch_count)
             for ds in wrapped:
-                batch = self._batch_dict(ds)
                 self.last_batch_size = ds.num_examples()
-                (self.params_tree, self.opt_state, self.state_tree,
-                 loss) = self._solver.step(
-                    self.params_tree, self.opt_state, self.state_tree,
-                    self.iteration_count, batch, self._rng.next_key())
-                last_loss = loss
-                for lst in self.listeners:
-                    lst.iteration_done(self, self.iteration_count,
-                                       self.epoch_count, loss)
-                self.iteration_count += 1
+                chunks = (self._tbptt_chunks(ds, self.conf.tbptt_fwd_length)
+                          if tbptt else [ds])
+                for chunk in chunks:
+                    batch = self._batch_dict(chunk)
+                    (self.params_tree, self.opt_state, self.state_tree,
+                     loss) = self._solver.step(
+                        self.params_tree, self.opt_state, self.state_tree,
+                        self.iteration_count, batch, self._rng.next_key())
+                    last_loss = loss
+                    for lst in self.listeners:
+                        lst.iteration_done(self, self.iteration_count,
+                                           self.epoch_count, loss)
+                    self.iteration_count += 1
+                # Recurrent carry must not leak across independent batches
+                # (within a batch, tBPTT chunks DO carry state — that is
+                # the point of truncated BPTT).
+                if self._has_rnn():
+                    self.rnn_clear_previous_state()
             # Increment BEFORE listeners so a checkpoint taken in
             # on_epoch_end records "N epochs completed" and resumes exactly.
             self.epoch_count += 1
@@ -239,6 +251,74 @@ class MultiLayerNetwork:
                 lst.on_epoch_end(self, self.epoch_count - 1)
             iterator.reset()
         return None if last_loss is None else float(last_loss)
+
+    # ------------------------------------------------------------------
+    # Recurrent state management (DL4J rnnTimeStep / tBPTT semantics)
+    # ------------------------------------------------------------------
+    def _has_rnn(self) -> bool:
+        return any(getattr(ly, "IS_RNN", False) for ly in self.layers)
+
+    @staticmethod
+    def _tbptt_chunks(ds: DataSet, length: int):
+        """Split a sequence DataSet along time into tBPTT segments
+        (DL4J ``MultiLayerNetwork.doTruncatedBPTT``)."""
+        t = ds.features.shape[1]
+        out = []
+        for start in range(0, t, length):
+            sl = slice(start, min(start + length, t))
+            out.append(DataSet(
+                ds.features[:, sl],
+                ds.labels[:, sl] if ds.labels.ndim == 3 else ds.labels,
+                None if ds.features_mask is None else ds.features_mask[:, sl],
+                None if ds.labels_mask is None else ds.labels_mask[:, sl],
+            ))
+        return out
+
+    def rnn_clear_previous_state(self):
+        """Drop stored recurrent carries (DL4J ``rnnClearPreviousState``)."""
+        from deeplearning4j_tpu.nn.conf.layers_recurrent import strip_rnn_carry
+        self._rnn_state_map = None
+        if self.state_tree is not None:
+            self.state_tree = strip_rnn_carry(self.state_tree)
+
+    def rnn_time_step(self, x, features_mask=None):
+        """Streaming inference: run these timesteps continuing from the
+        stored recurrent state, store the new state (DL4J ``rnnTimeStep``).
+        ``x``: [b, t, f] (or [b, f] for a single step -> returns [b, out]).
+
+        Like DL4J's ``stateMap``, the streaming carry lives in a SEPARATE
+        map (not the model's state tree), so interleaved ``output``/
+        ``score`` calls still start from zero state."""
+        self._check_init()
+        x = jnp.asarray(x)
+        single = x.ndim == 2
+        if single:
+            x = x[:, None, :]
+        if features_mask is not None:
+            features_mask = jnp.asarray(features_mask)
+        carry = getattr(self, "_rnn_state_map", None)
+        state_in = dict(self.state_tree)
+        if carry is not None:
+            for lname, lcarry in carry.items():
+                state_in[lname] = {**state_in[lname], **lcarry}
+        y, new_state = self._rnn_step_jit(
+            self.params_tree, state_in, x, features_mask)
+        self._rnn_state_map = {
+            lname: {k: v for k, v in lstate.items()
+                    if k.startswith("rnn_")}
+            for lname, lstate in new_state.items()}
+        return y[:, -1] if single else y
+
+    def _rnn_step_impl(self, params, state, x, mask):
+        y, new_state = self._forward_layers(params, state, x, False, None,
+                                            mask=mask)
+        return y, new_state
+
+    @property
+    def _rnn_step_jit(self):
+        if not hasattr(self, "_rnn_step_fn"):
+            self._rnn_step_fn = jax.jit(self._rnn_step_impl)
+        return self._rnn_step_fn
 
     # ------------------------------------------------------------------
     # Inference / scoring
@@ -316,30 +396,32 @@ class MultiLayerNetwork:
     # Parameter access (DL4J flattened-vector parity views)
     # ------------------------------------------------------------------
     def _leaf_order(self):
+        """((path...), leaf) pairs, layer-major then name-sorted (nested
+        dicts — e.g. Bidirectional's {fwd, bwd} — walked depth-first)."""
+        from deeplearning4j_tpu.utils.trees import iter_leaves
         for i in range(len(self.layers)):
-            lp = self.params_tree[f"layer_{i}"]
-            for name in sorted(lp.keys()):
-                yield f"layer_{i}", name
+            for path, leaf in iter_leaves(self.params_tree[f"layer_{i}"]):
+                yield (f"layer_{i}",) + path, leaf
 
     def params(self) -> np.ndarray:
         """One flattened host vector, layer-major then name-sorted — the
         DL4J ``params()`` view (order: per layer W then b)."""
         self._check_init()
-        parts = [np.asarray(self.params_tree[l][n]).reshape(-1)
-                 for l, n in self._leaf_order()]
+        parts = [np.asarray(leaf).reshape(-1)
+                 for _, leaf in self._leaf_order()]
         return (np.concatenate(parts) if parts
                 else np.zeros((0,), np.float32))
 
     def set_params(self, vector: np.ndarray):
+        from deeplearning4j_tpu.utils.trees import deep_copy_dicts, set_path
         self._check_init()
         vector = np.asarray(vector)
         off = 0
-        new = {k: dict(v) for k, v in self.params_tree.items()}
-        for l, n in self._leaf_order():
-            arr = self.params_tree[l][n]
+        new = deep_copy_dicts(self.params_tree)
+        for path, arr in self._leaf_order():
             size = int(np.prod(arr.shape)) if arr.shape else 1
-            new[l][n] = jnp.asarray(
-                vector[off:off + size].reshape(arr.shape), arr.dtype)
+            set_path(new, path, jnp.asarray(
+                vector[off:off + size].reshape(arr.shape), arr.dtype))
             off += size
         if off != vector.size:
             raise ValueError(f"Expected {off} values, got {vector.size}")
@@ -375,11 +457,13 @@ class MultiLayerNetwork:
     def summary(self) -> str:
         """Layer table (DL4J ``summary()``)."""
         self._check_init()
+        from deeplearning4j_tpu.utils.trees import iter_leaves
         rows = [f"{'idx':<4} {'name':<22} {'type':<24} {'#params':>10}"]
         total = 0
         for i, ly in enumerate(self.layers):
             lp = self.params_tree[f"layer_{i}"]
-            n = sum(int(np.prod(np.asarray(a).shape)) for a in lp.values())
+            n = sum(int(np.prod(np.asarray(a).shape))
+                    for _, a in iter_leaves(lp))
             total += n
             rows.append(f"{i:<4} {(ly.name or f'layer_{i}'):<22} "
                         f"{type(ly).__name__:<24} {n:>10}")

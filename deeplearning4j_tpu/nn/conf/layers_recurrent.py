@@ -1,0 +1,484 @@
+"""Recurrent layers: LSTM / GravesLSTM / GRU / SimpleRnn + wrappers.
+
+Parity targets (upstream `deeplearning4j-nn`):
+  ``org.deeplearning4j.nn.conf.layers.{LSTM,GravesLSTM,SimpleRnn,
+  RnnOutputLayer,LastTimeStep}`` and ``...conf.layers.recurrent.Bidirectional``;
+  runtime twins in ``org.deeplearning4j.nn.layers.recurrent.**`` (plus the
+  cuDNN ``CudnnLSTMHelper`` this framework replaces with an XLA lowering).
+
+TPU-first recurrence design (this is NOT how DL4J computes it):
+* The input projection for ALL timesteps is hoisted out of the recurrence
+  into one [b·t, n_in] x [n_in, 4h] matmul — a single large MXU op.
+* Only the [b, h] x [h, 4h] recurrent matmul runs inside ``lax.scan`` —
+  XLA compiles the scan to one fused while-loop on device (no per-timestep
+  dispatch, unlike DL4J's per-step INDArray ops).
+* Masked timesteps HOLD the carried state and zero the emitted activation
+  (DL4J masking semantics), implemented with ``jnp.where`` inside the scan
+  so the whole thing stays trace-able with static shapes.
+
+Sequence layout is [batch, time, features]; the scan runs time-major
+internally (transpose at the boundary — free inside XLA fusion).
+
+State/carry convention: the recurrent carry (keys ``rnn_h``/``rnn_c``) is
+stored in the layer's state tree ONLY when the model is carrying state
+across calls (tBPTT chunks, ``rnn_time_step``).  The carry is batch-sized,
+so models strip it between independent batches (``strip_rnn_carry``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.base import BaseLayerConf, register_layer
+from deeplearning4j_tpu.nn.conf.layers_core import (
+    OutputLayer, apply_dropout)
+from deeplearning4j_tpu.nn.weights_init import init_weights
+
+
+def strip_rnn_carry(state_tree):
+    """Drop batch-sized recurrent carries (keys 'rnn_*') from a state tree
+    — called between independent batches so no state leaks across them."""
+    def strip(node):
+        if isinstance(node, dict):
+            return {k: strip(v) for k, v in node.items()
+                    if not k.startswith("rnn_")}
+        return node
+    return strip(state_tree)
+
+
+class BaseRecurrentConf(BaseLayerConf):
+    """Shared recurrent plumbing; subclasses define cell math."""
+
+    IS_RNN = True
+    USES_MASK = True
+    WANTED_KINDS = ("rnn",)
+    OUTPUT_KIND = "rnn"
+
+    def infer_shapes(self, input_shape):
+        t, f = input_shape
+        if self.n_in is None:
+            self.n_in = int(f)
+        return (t, self.n_out)
+
+    def has_params(self):
+        return True
+
+    def carry_init(self, batch: int, dtype):
+        """Zero carry for a fresh sequence; dict of 'rnn_*' arrays."""
+        raise NotImplementedError
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None, mask=None):
+        """x: [b, t, f] -> [b, t, h].  Initial carry is taken from `state`
+        when present (tBPTT / rnnTimeStep continuation), else zeros; the
+        final carry is returned in the new state."""
+        b = x.shape[0]
+        dtype = params[next(iter(params))].dtype
+        carry = {k: state[k] for k in self.carry_init(1, dtype)
+                 if k in state}
+        if not carry or next(iter(carry.values())).shape[0] != b:
+            carry = self.carry_init(b, dtype)
+        y, new_carry = self.apply_seq(params, x, carry, mask, compute_dtype)
+        y = apply_dropout(y, self.dropout, training, rng)
+        new_state = dict(state)
+        new_state.update(new_carry)
+        return y, new_state
+
+    def apply_seq(self, params, x, carry, mask, compute_dtype):
+        raise NotImplementedError
+
+    def regularized_param_names(self):
+        return ("W", "R")
+
+
+def _time_major(x):
+    return jnp.swapaxes(x, 0, 1)
+
+
+@register_layer
+@dataclasses.dataclass
+class LSTM(BaseRecurrentConf):
+    """LSTM without peepholes (``org.deeplearning4j.nn.conf.layers.LSTM``;
+    native kernel ``libnd4j .../declarable/generic/nn/recurrent/lstmLayer.cpp``).
+
+    Gate layout in the fused [.., 4h] projection: input, forget, cell(g),
+    output.  ``forget_gate_bias_init`` defaults to 1.0 as upstream.
+    """
+
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    # DL4J LSTM default activation is tanh (not the global default)
+    activation: Optional[str] = "tanh"
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+
+    def init(self, key, dtype=jnp.float32):
+        kx, kr = jax.random.split(key)
+        h = self.n_out
+        w = init_weights(kx, (self.n_in, 4 * h), self.n_in, 4 * h,
+                         self.weight_init, dtype, self.weight_distribution)
+        r = init_weights(kr, (h, 4 * h), h, 4 * h,
+                         self.weight_init, dtype, self.weight_distribution)
+        b = jnp.zeros((4 * h,), dtype)
+        # forget-gate slice [h:2h] gets the bias init (DL4J
+        # LSTMParamInitializer.setForgetGateBiasInit)
+        b = b.at[h:2 * h].set(self.forget_gate_bias_init)
+        return {"W": w, "R": r, "b": b}, {}
+
+    def carry_init(self, batch, dtype):
+        return {"rnn_h": jnp.zeros((batch, self.n_out), dtype),
+                "rnn_c": jnp.zeros((batch, self.n_out), dtype)}
+
+    def _gates(self, z, c_prev, params, sigma, act):
+        h = self.n_out
+        i = sigma(z[:, :h])
+        f = sigma(z[:, h:2 * h])
+        g = act(z[:, 2 * h:3 * h])
+        o_pre = z[:, 3 * h:]
+        return i, f, g, o_pre
+
+    def apply_seq(self, params, x, carry, mask, compute_dtype):
+        dtype = params["W"].dtype
+        w, r, bias = params["W"], params["R"], params["b"]
+        if compute_dtype is not None:
+            x, w, r = (a.astype(compute_dtype) for a in (x, w, r))
+        sigma = get_activation(self.gate_activation)
+        act = get_activation(self.activation or "tanh")
+        # ONE big MXU matmul for every timestep's input projection:
+        xz = (x @ w).astype(dtype) + bias          # [b, t, 4h]
+        xz_t = _time_major(xz)                     # [t, b, 4h]
+        mask_t = None if mask is None else _time_major(mask)
+        h0, c0 = carry["rnn_h"], carry["rnn_c"]
+
+        def step(hc, inp):
+            h_prev, c_prev = hc
+            z_x, m = inp
+            z = z_x + (h_prev.astype(w.dtype) @ r).astype(dtype)
+            i, f, g, o_pre = self._gates(z, c_prev, params, sigma, act)
+            c_new = f * c_prev + i * g
+            o = sigma(self._peep_o(o_pre, c_new, params))
+            h_new = o * act(c_new)
+            if m is not None:
+                mm = m[:, None].astype(h_new.dtype)
+                h_new = h_new * mm + h_prev * (1 - mm)
+                c_new = c_new * mm + c_prev * (1 - mm)
+                y = h_new * mm
+            else:
+                y = h_new
+            return (h_new, c_new), y
+
+        if mask_t is None:
+            (hT, cT), ys = lax.scan(lambda hc, zx: step(hc, (zx, None)),
+                                    (h0, c0), xz_t)
+        else:
+            (hT, cT), ys = lax.scan(step, (h0, c0), (xz_t, mask_t))
+        return _time_major(ys), {"rnn_h": hT, "rnn_c": cT}
+
+    def _peep_o(self, o_pre, c_new, params):
+        return o_pre
+
+
+@register_layer
+@dataclasses.dataclass
+class GravesLSTM(LSTM):
+    """Peephole LSTM per Graves (2013) — upstream ``GravesLSTM`` (the
+    char-RNN baseline layer).  Peepholes: i,f see c_{t-1}; o sees c_t."""
+
+    def init(self, key, dtype=jnp.float32):
+        params, state = super().init(key, dtype)
+        h = self.n_out
+        params["P"] = jnp.zeros((3, h), dtype)  # p_i, p_f, p_o
+        return params, state
+
+    def _gates(self, z, c_prev, params, sigma, act):
+        h = self.n_out
+        p = params["P"].astype(z.dtype)
+        i = sigma(z[:, :h] + p[0] * c_prev)
+        f = sigma(z[:, h:2 * h] + p[1] * c_prev)
+        g = act(z[:, 2 * h:3 * h])
+        o_pre = z[:, 3 * h:]
+        return i, f, g, o_pre
+
+    def _peep_o(self, o_pre, c_new, params):
+        return o_pre + params["P"].astype(o_pre.dtype)[2] * c_new
+
+
+@register_layer
+@dataclasses.dataclass
+class GRU(BaseRecurrentConf):
+    """GRU (libnd4j ``gruCell``; upstream exposes it via SameDiff ops).
+    Gate layout [.., 3h]: reset, update, candidate."""
+
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    activation: Optional[str] = "tanh"
+    gate_activation: str = "sigmoid"
+
+    def init(self, key, dtype=jnp.float32):
+        kx, kr = jax.random.split(key)
+        h = self.n_out
+        w = init_weights(kx, (self.n_in, 3 * h), self.n_in, 3 * h,
+                         self.weight_init, dtype, self.weight_distribution)
+        r = init_weights(kr, (h, 3 * h), h, 3 * h,
+                         self.weight_init, dtype, self.weight_distribution)
+        return {"W": w, "R": r, "b": jnp.zeros((3 * h,), dtype)}, {}
+
+    def carry_init(self, batch, dtype):
+        return {"rnn_h": jnp.zeros((batch, self.n_out), dtype)}
+
+    def apply_seq(self, params, x, carry, mask, compute_dtype):
+        dtype = params["W"].dtype
+        w, r, bias = params["W"], params["R"], params["b"]
+        if compute_dtype is not None:
+            x, w, r = (a.astype(compute_dtype) for a in (x, w, r))
+        sigma = get_activation(self.gate_activation)
+        act = get_activation(self.activation or "tanh")
+        h = self.n_out
+        xz_t = _time_major((x @ w).astype(dtype) + bias)
+        mask_t = None if mask is None else _time_major(mask)
+
+        def step(h_prev, inp):
+            z_x, m = inp
+            hz = (h_prev.astype(w.dtype) @ r).astype(dtype)
+            rg = sigma(z_x[:, :h] + hz[:, :h])
+            ug = sigma(z_x[:, h:2 * h] + hz[:, h:2 * h])
+            cand = act(z_x[:, 2 * h:] + rg * hz[:, 2 * h:])
+            h_new = ug * h_prev + (1 - ug) * cand
+            if m is not None:
+                mm = m[:, None].astype(h_new.dtype)
+                h_new = h_new * mm + h_prev * (1 - mm)
+                y = h_new * mm
+            else:
+                y = h_new
+            return h_new, y
+
+        if mask_t is None:
+            hT, ys = lax.scan(lambda hp, zx: step(hp, (zx, None)),
+                              carry["rnn_h"], xz_t)
+        else:
+            hT, ys = lax.scan(step, carry["rnn_h"], (xz_t, mask_t))
+        return _time_major(ys), {"rnn_h": hT}
+
+
+@register_layer
+@dataclasses.dataclass
+class SimpleRnn(BaseRecurrentConf):
+    """Elman RNN (``org.deeplearning4j.nn.conf.layers.recurrent.SimpleRnn``):
+    h_t = act(x_t W + h_{t-1} R + b)."""
+
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    activation: Optional[str] = "tanh"
+
+    def init(self, key, dtype=jnp.float32):
+        kx, kr = jax.random.split(key)
+        h = self.n_out
+        w = init_weights(kx, (self.n_in, h), self.n_in, h,
+                         self.weight_init, dtype, self.weight_distribution)
+        r = init_weights(kr, (h, h), h, h,
+                         self.weight_init, dtype, self.weight_distribution)
+        return {"W": w, "R": r, "b": jnp.zeros((h,), dtype)}, {}
+
+    def carry_init(self, batch, dtype):
+        return {"rnn_h": jnp.zeros((batch, self.n_out), dtype)}
+
+    def apply_seq(self, params, x, carry, mask, compute_dtype):
+        dtype = params["W"].dtype
+        w, r, bias = params["W"], params["R"], params["b"]
+        if compute_dtype is not None:
+            x, w, r = (a.astype(compute_dtype) for a in (x, w, r))
+        act = get_activation(self.activation or "tanh")
+        xz_t = _time_major((x @ w).astype(dtype) + bias)
+        mask_t = None if mask is None else _time_major(mask)
+
+        def step(h_prev, inp):
+            z_x, m = inp
+            h_new = act(z_x + (h_prev.astype(w.dtype) @ r).astype(dtype))
+            if m is not None:
+                mm = m[:, None].astype(h_new.dtype)
+                h_new = h_new * mm + h_prev * (1 - mm)
+                y = h_new * mm
+            else:
+                y = h_new
+            return h_new, y
+
+        if mask_t is None:
+            hT, ys = lax.scan(lambda hp, zx: step(hp, (zx, None)),
+                              carry["rnn_h"], xz_t)
+        else:
+            hT, ys = lax.scan(step, carry["rnn_h"], (xz_t, mask_t))
+        return _time_major(ys), {"rnn_h": hT}
+
+
+def reverse_sequence(x, mask):
+    """Mask-aware time reversal: each example's VALID prefix is reversed
+    in place, padding stays at the end (DL4J ``ReverseTimeSeriesVertex``
+    with a mask; plain flip when unmasked)."""
+    if mask is None:
+        return jnp.flip(x, axis=1)
+    t = x.shape[1]
+    lengths = jnp.sum(mask.astype(jnp.int32), axis=1)          # [b]
+    ar = jnp.arange(t)[None, :]                                # [1, t]
+    idx = jnp.where(ar < lengths[:, None], lengths[:, None] - 1 - ar, ar)
+    return jnp.take_along_axis(
+        x, idx[..., None] if x.ndim == 3 else idx, axis=1)
+
+
+@register_layer
+@dataclasses.dataclass
+class Bidirectional(BaseLayerConf):
+    """Bidirectional wrapper (``...conf.layers.recurrent.Bidirectional``):
+    runs the wrapped recurrent layer forward and (mask-aware) reversed,
+    combining with mode CONCAT | ADD | MUL | AVERAGE."""
+
+    layer: Optional[BaseRecurrentConf] = None
+    mode: str = "concat"
+
+    IS_RNN = True
+    USES_MASK = True
+    WANTED_KINDS = ("rnn",)
+    OUTPUT_KIND = "rnn"
+
+    def __post_init__(self):
+        if isinstance(self.layer, dict):
+            from deeplearning4j_tpu.nn.conf.base import layer_from_dict
+            self.layer = layer_from_dict(self.layer)
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["layer"] = self.layer.to_dict()
+        return d
+
+    def resolve_defaults(self, global_conf):
+        super().resolve_defaults(global_conf)
+        self.layer.resolve_defaults(global_conf)
+
+    def infer_shapes(self, input_shape):
+        t, h = self.layer.infer_shapes(input_shape)
+        return (t, 2 * h if self.mode == "concat" else h)
+
+    def has_params(self):
+        return True
+
+    def init(self, key, dtype=jnp.float32):
+        kf, kb = jax.random.split(key)
+        pf, _ = self.layer.init(kf, dtype)
+        pb, _ = self.layer.init(kb, dtype)
+        return {"fwd": pf, "bwd": pb}, {}
+
+    def regularized_param_names(self):
+        # Path-addressed names into the nested {fwd, bwd} param dicts.
+        inner = self.layer.regularized_param_names()
+        return tuple(f"{d}/{n}" for d in ("fwd", "bwd") for n in inner)
+
+    def carry_init(self, batch, dtype):
+        return {}  # bidirectional layers cannot stream (need full sequence)
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None, mask=None):
+        zero = self.layer.carry_init(x.shape[0], params["fwd"]["W"].dtype)
+        yf, _ = self.layer.apply_seq(params["fwd"], x, zero, mask,
+                                     compute_dtype)
+        xr = reverse_sequence(x, mask)
+        yb, _ = self.layer.apply_seq(params["bwd"], xr, zero, mask,
+                                     compute_dtype)
+        yb = reverse_sequence(yb, mask)
+        mode = self.mode.lower()
+        if mode == "concat":
+            y = jnp.concatenate([yf, yb], axis=-1)
+        elif mode == "add":
+            y = yf + yb
+        elif mode == "mul":
+            y = yf * yb
+        elif mode == "average":
+            y = (yf + yb) * 0.5
+        else:
+            raise ValueError(f"Unknown Bidirectional mode {self.mode!r}")
+        return apply_dropout(y, self.dropout, training, rng), state
+
+
+@register_layer
+@dataclasses.dataclass
+class LastTimeStep(BaseLayerConf):
+    """Wrapper reducing [b, t, h] to the LAST VALID timestep's [b, h]
+    (``...conf.layers.recurrent.LastTimeStep``)."""
+
+    layer: Optional[BaseLayerConf] = None
+
+    USES_MASK = True
+    WANTED_KINDS = ("rnn",)
+    OUTPUT_KIND = "ff"
+
+    @property
+    def IS_RNN(self):
+        # The wrapped recurrent layer writes a carry into this layer's
+        # state dict, so models must strip it between batches too.
+        return self.layer is not None and getattr(self.layer, "IS_RNN", False)
+
+    def __post_init__(self):
+        if isinstance(self.layer, dict):
+            from deeplearning4j_tpu.nn.conf.base import layer_from_dict
+            self.layer = layer_from_dict(self.layer)
+
+    def to_dict(self):
+        d = super().to_dict()
+        if self.layer is not None:
+            d["layer"] = self.layer.to_dict()
+        return d
+
+    def resolve_defaults(self, global_conf):
+        super().resolve_defaults(global_conf)
+        if self.layer is not None:
+            self.layer.resolve_defaults(global_conf)
+
+    def infer_shapes(self, input_shape):
+        if self.layer is not None:
+            t, h = self.layer.infer_shapes(input_shape)
+            return (h,)
+        return (input_shape[-1],)
+
+    def has_params(self):
+        return self.layer is not None and self.layer.has_params()
+
+    def init(self, key, dtype=jnp.float32):
+        return self.layer.init(key, dtype) if self.layer is not None else ({}, {})
+
+    def regularized_param_names(self):
+        return self.layer.regularized_param_names() if self.layer is not None \
+            else ()
+
+    def apply(self, params, state, x, *, training: bool, rng=None,
+              compute_dtype=None, mask=None):
+        if self.layer is not None:
+            kwargs = {"mask": mask} if getattr(self.layer, "USES_MASK",
+                                               False) else {}
+            x, state = self.layer.apply(params, state, x, training=training,
+                                        rng=rng, compute_dtype=compute_dtype,
+                                        **kwargs)
+        return last_time_step(x, mask), state
+
+
+def last_time_step(x, mask):
+    """[b, t, h] -> [b, h] at each example's last valid timestep."""
+    if mask is None:
+        return x[:, -1]
+    lengths = jnp.sum(mask.astype(jnp.int32), axis=1)
+    idx = jnp.maximum(lengths - 1, 0)
+    return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+
+
+@register_layer
+@dataclasses.dataclass
+class RnnOutputLayer(OutputLayer):
+    """Per-timestep output layer over [b, t, f]
+    (``org.deeplearning4j.nn.conf.layers.RnnOutputLayer``): the dense
+    projection broadcasts over time ([b, t, in] @ [in, out]); the base
+    scorer already handles 3-D pre-activations per timestep with masks."""
+
+    WANTED_KINDS = ("rnn",)
+    OUTPUT_KIND = "rnn"

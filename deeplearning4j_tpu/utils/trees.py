@@ -1,0 +1,43 @@
+"""Nested-dict parameter-tree helpers shared by the model classes
+(flattened-vector views, path-addressed access).  The DL4J analogue is the
+flattened params vector + per-layer views of ``MultiLayerNetwork.params()``;
+here layers may nest dicts arbitrarily (e.g. Bidirectional's {fwd, bwd})."""
+from __future__ import annotations
+
+from typing import Dict, Iterator, Tuple
+
+
+def iter_leaves(tree: Dict, prefix: Tuple[str, ...] = ()) -> Iterator:
+    """Yield ((path, ...), leaf) depth-first with sorted keys at each level
+    — the deterministic order of the flattened-params view."""
+    for k in sorted(tree.keys()):
+        v = tree[k]
+        if isinstance(v, dict):
+            yield from iter_leaves(v, prefix + (k,))
+        else:
+            yield prefix + (k,), v
+
+
+def get_path(tree: Dict, path: str):
+    """Resolve 'a/b/c' into nested dicts; returns None when absent."""
+    node = tree
+    for part in path.split("/"):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def set_path(tree: Dict, path, value) -> None:
+    parts = path.split("/") if isinstance(path, str) else list(path)
+    node = tree
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def deep_copy_dicts(tree):
+    """Copy the dict skeleton (leaves shared) — safe to mutate structure."""
+    if isinstance(tree, dict):
+        return {k: deep_copy_dicts(v) for k, v in tree.items()}
+    return tree

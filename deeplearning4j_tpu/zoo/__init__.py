@@ -11,6 +11,7 @@ from deeplearning4j_tpu.zoo.alexnet import AlexNet
 from deeplearning4j_tpu.zoo.vgg import VGG16, VGG19
 from deeplearning4j_tpu.zoo.resnet import ResNet50
 from deeplearning4j_tpu.zoo.simple_cnn import SimpleCNN
+from deeplearning4j_tpu.zoo.text_generation_lstm import TextGenerationLSTM
 
 __all__ = ["ZooModel", "LeNet", "AlexNet", "VGG16", "VGG19", "ResNet50",
-           "SimpleCNN"]
+           "SimpleCNN", "TextGenerationLSTM"]

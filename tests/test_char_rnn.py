@@ -1,0 +1,37 @@
+"""Char-RNN end-to-end: CharacterIterator + TextGenerationLSTM + sampling
+— the GravesLSTM char-RNN baseline config (dl4j-examples
+``LSTMCharModellingExample``)."""
+import numpy as np
+
+from deeplearning4j_tpu.data.char_iterator import (
+    CharacterIterator, sample_characters)
+from deeplearning4j_tpu.zoo import TextGenerationLSTM
+
+TEXT = ("the quick brown fox jumps over the lazy dog. " * 40)
+
+
+def test_char_iterator_shapes():
+    it = CharacterIterator(TEXT, seq_length=20, batch=4)
+    ds = next(iter(it))
+    v = it.vocab_size
+    assert ds.features.shape == (4, 20, v)
+    assert ds.labels.shape == (4, 20, v)
+    # labels are features shifted by one step
+    f_idx = ds.features.argmax(-1)
+    l_idx = ds.labels.argmax(-1)
+    assert np.all(f_idx[:, 1:] == l_idx[:, :-1])
+
+
+def test_char_rnn_learns_and_samples():
+    it = CharacterIterator(TEXT, seq_length=30, batch=8, seed=1)
+    model = TextGenerationLSTM(vocab_size=it.vocab_size, hidden=64,
+                               n_layers=1, tbptt_length=15,
+                               seed=5).init_graph()
+    first = model.fit(it, n_epochs=1, async_prefetch=False)
+    for _ in range(14):
+        last = model.fit(it, n_epochs=1, async_prefetch=False)
+    assert last < first * 0.8, (first, last)
+    text = sample_characters(model, it, init="the ", n_chars=40,
+                             temperature=0.5)
+    assert len(text) == 44
+    assert all(c in it.char_to_idx for c in text)

@@ -1,0 +1,272 @@
+"""Recurrent stack: LSTM/GravesLSTM/GRU/SimpleRnn cell math, masking,
+tBPTT, streaming rnn_time_step, bidirectional — parity with upstream
+``LSTMGradientCheckTests`` / ``GravesLSTMTest`` / ``TestRnnLayers`` and the
+tBPTT paths of ``MultiLayerNetwork`` (SURVEY.md §5.7)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers_recurrent import (
+    GRU, Bidirectional, GravesLSTM, LSTM, LastTimeStep, RnnOutputLayer,
+    SimpleRnn, last_time_step, reverse_sequence)
+from deeplearning4j_tpu.nn.conf.layers_core import OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+
+def _seq_model(layer, n_in=6, n_out=4, seed=3, tbptt=None):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(Adam(learning_rate=5e-3))
+         .list()
+         .layer(layer)
+         .layer(RnnOutputLayer(n_out=n_out, activation="softmax",
+                               loss="mcxent"))
+         .set_input_type(InputType.recurrent(n_in)))
+    if tbptt:
+        b.backprop_type("truncated_bptt", tbptt)
+    return MultiLayerNetwork(b.build()).init()
+
+
+def _toy_seq(rng, b=16, t=12, n_in=6, n_cls=4):
+    """Label at each step = argmax of the input a step earlier (forces the
+    net to use its recurrent state)."""
+    x = rng.normal(size=(b, t, n_in)).astype(np.float32)
+    src = np.argmax(x[:, :-1, :n_cls], axis=-1)
+    lab = np.concatenate([np.zeros((b, 1), np.int64), src], axis=1)
+    y = np.eye(n_cls, dtype=np.float32)[lab]
+    return x, y
+
+
+def _numpy_lstm(x, W, R, bias, h0, c0):
+    """Reference LSTM (gate order i,f,g,o; sigmoid gates, tanh act)."""
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+    b, t, _ = x.shape
+    h_dim = R.shape[0]
+    h, c = h0.copy(), c0.copy()
+    ys = []
+    for step in range(t):
+        z = x[:, step] @ W + h @ R + bias
+        i, f, g, o = (z[:, :h_dim], z[:, h_dim:2 * h_dim],
+                      z[:, 2 * h_dim:3 * h_dim], z[:, 3 * h_dim:])
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        ys.append(h)
+    return np.stack(ys, 1), h, c
+
+
+def test_lstm_matches_numpy_reference(rng):
+    ly = LSTM(n_in=5, n_out=7, weight_init="xavier")
+    import jax
+    params, _ = ly.init(jax.random.PRNGKey(0))
+    x = rng.normal(size=(3, 9, 5)).astype(np.float32)
+    y, state = ly.apply(params, {}, x, training=False)
+    ref, hT, cT = _numpy_lstm(
+        x, np.asarray(params["W"]), np.asarray(params["R"]),
+        np.asarray(params["b"]), np.zeros((3, 7), np.float32),
+        np.zeros((3, 7), np.float32))
+    assert np.allclose(np.asarray(y), ref, atol=1e-5)
+    assert np.allclose(np.asarray(state["rnn_h"]), hT, atol=1e-5)
+    assert np.allclose(np.asarray(state["rnn_c"]), cT, atol=1e-5)
+
+
+def test_lstm_forget_bias_init():
+    import jax
+    ly = LSTM(n_in=4, n_out=3, weight_init="xavier",
+              forget_gate_bias_init=1.0)
+    params, _ = ly.init(jax.random.PRNGKey(0))
+    b = np.asarray(params["b"])
+    assert np.all(b[3:6] == 1.0) and np.all(b[:3] == 0.0)
+
+
+@pytest.mark.parametrize("layer_fn", [
+    lambda: LSTM(n_out=8, activation="tanh"),
+    lambda: GravesLSTM(n_out=8, activation="tanh"),
+    lambda: GRU(n_out=8, activation="tanh"),
+    lambda: SimpleRnn(n_out=8, activation="tanh"),
+])
+def test_recurrent_layers_learn_shifted_argmax(rng, layer_fn):
+    model = _seq_model(layer_fn())
+    x, y = _toy_seq(rng, b=32)
+    ds = DataSet(x, y)
+    s0 = model.score(ds)
+    for _ in range(150):
+        model.fit(ds)
+    s1 = model.score(ds)
+    assert s1 < s0 * 0.6, (s0, s1)
+
+
+def test_masking_holds_state_and_zeroes_output(rng):
+    import jax
+    ly = LSTM(n_in=4, n_out=5, activation="tanh", weight_init="xavier")
+    params, _ = ly.init(jax.random.PRNGKey(1))
+    x = rng.normal(size=(2, 6, 4)).astype(np.float32)
+    mask = np.ones((2, 6), np.float32)
+    mask[0, 3:] = 0.0  # example 0: only 3 valid steps
+    y, state = ly.apply(params, {}, x, training=False, mask=mask)
+    y = np.asarray(y)
+    # masked outputs are exactly zero
+    assert np.all(y[0, 3:] == 0.0)
+    # final carry equals the step-2 hidden state (held through padding)
+    y_short, state_short = ly.apply(params, {}, x[:, :3], training=False)
+    assert np.allclose(np.asarray(state["rnn_h"])[0],
+                       np.asarray(state_short["rnn_h"])[0], atol=1e-6)
+
+
+def test_rnn_time_step_streaming_matches_full_forward(rng):
+    model = _seq_model(LSTM(n_out=8, activation="tanh"))
+    x, _ = _toy_seq(rng, b=4, t=10)
+    full = np.asarray(model.output(x))
+    model.rnn_clear_previous_state()
+    h1 = np.asarray(model.rnn_time_step(x[:, :4]))
+    h2 = np.asarray(model.rnn_time_step(x[:, 4:]))
+    stream = np.concatenate([h1, h2], axis=1)
+    assert np.allclose(stream, full, atol=1e-5)
+    # single-step form returns [b, out]
+    model.rnn_clear_previous_state()
+    s = model.rnn_time_step(x[:, 0])
+    assert s.shape == (4, 4)
+
+
+def test_tbptt_fit_runs_and_counts_iterations(rng):
+    model = _seq_model(LSTM(n_out=8, activation="tanh"), tbptt=4)
+    x, y = _toy_seq(rng, b=8, t=12)
+    model.fit(DataSet(x, y))
+    # 12 steps / tbptt 4 = 3 chunks = 3 iterations
+    assert model.iteration_count == 3
+    # carry stripped after the batch
+    assert not any(k.startswith("rnn_")
+                   for k in model.state_tree["layer_0"])
+
+
+def test_tbptt_converges(rng):
+    model = _seq_model(GravesLSTM(n_out=12, activation="tanh"), tbptt=6)
+    x, y = _toy_seq(rng, b=32, t=12)
+    ds = DataSet(x, y)
+    s0 = model.score(ds)
+    for _ in range(80):
+        model.fit(ds)
+    assert model.score(ds) < s0 * 0.7
+
+
+def test_reverse_sequence_mask_aware():
+    x = np.arange(12, dtype=np.float32).reshape(1, 4, 3)
+    x = np.concatenate([x, x + 100], axis=0)
+    mask = np.array([[1, 1, 1, 0], [1, 1, 1, 1]], np.float32)
+    r = np.asarray(reverse_sequence(x, mask))
+    # example 0: first 3 steps reversed, padding step untouched
+    assert np.allclose(r[0, :3], x[0, :3][::-1])
+    assert np.allclose(r[0, 3], x[0, 3])
+    # example 1: full flip
+    assert np.allclose(r[1], x[1][::-1])
+
+
+def test_last_time_step_layer(rng):
+    x = rng.normal(size=(3, 5, 4)).astype(np.float32)
+    mask = np.array([[1, 1, 0, 0, 0], [1, 1, 1, 1, 1], [1, 0, 0, 0, 0]],
+                    np.float32)
+    out = np.asarray(last_time_step(x, mask))
+    assert np.allclose(out[0], x[0, 1])
+    assert np.allclose(out[1], x[1, 4])
+    assert np.allclose(out[2], x[2, 0])
+
+
+def test_bidirectional_concat_and_classification(rng):
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=5e-3))
+            .list()
+            .layer(Bidirectional(layer=LSTM(n_out=8, activation="tanh"),
+                                 mode="concat"))
+            .layer(LastTimeStep(layer=LSTM(n_out=8, activation="tanh")))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(4))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    # sequence classification: does the sequence sum start positive?
+    x = rng.normal(size=(32, 6, 4)).astype(np.float32)
+    lab = (x[:, 0].sum(-1) > 0).astype(np.int64)
+    y = np.eye(2, dtype=np.float32)[lab]
+    ds = DataSet(x, y)
+    s0 = model.score(ds)
+    for _ in range(100):
+        model.fit(ds)
+    assert model.score(ds) < s0
+
+
+def test_recurrent_json_round_trip():
+    from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+    model = _seq_model(GravesLSTM(n_out=8, activation="tanh"), tbptt=4)
+    s = model.conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(s)
+    assert isinstance(conf2.layers[0], GravesLSTM)
+    assert conf2.tbptt_fwd_length == 4
+    m2 = MultiLayerNetwork(conf2).init(seed=3)
+    x = np.zeros((2, 5, 6), np.float32)
+    assert np.asarray(m2.output(x)).shape == (2, 5, 4)
+
+
+def test_rnn_time_step_does_not_pollute_output(rng):
+    """DL4J keeps rnnTimeStep state in a separate stateMap: output() after
+    streaming must still start from zero state."""
+    model = _seq_model(LSTM(n_out=8, activation="tanh"))
+    x, _ = _toy_seq(rng, b=4, t=10)
+    clean = np.asarray(model.output(x))
+    model.rnn_time_step(x)  # stores streaming carry
+    again = np.asarray(model.output(x))
+    assert np.allclose(clean, again, atol=1e-6)
+    # and streaming continues independently
+    model.rnn_clear_previous_state()
+    h1 = np.asarray(model.rnn_time_step(x[:, :5]))
+    _ = np.asarray(model.output(x))  # interleaved inference
+    h2 = np.asarray(model.rnn_time_step(x[:, 5:]))
+    full = np.asarray(model.output(x))
+    assert np.allclose(np.concatenate([h1, h2], 1), full, atol=1e-5)
+
+
+def test_carry_not_leaked_with_last_time_step_wrapper(rng):
+    """LastTimeStep(LSTM) must still count as recurrent for carry
+    stripping between batches."""
+    conf = (NeuralNetConfiguration.builder().seed(2)
+            .updater(Adam(learning_rate=1e-3)).list()
+            .layer(LastTimeStep(layer=LSTM(n_out=6)))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(3)).build())
+    model = MultiLayerNetwork(conf).init()
+    x = rng.normal(size=(8, 5, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    model.fit(DataSet(x, y))
+    assert not any(k.startswith("rnn_")
+                   for k in model.state_tree["layer_0"])
+
+
+def test_bidirectional_params_vector_round_trip(rng):
+    """Flattened-params APIs must handle the nested {fwd,bwd} layout."""
+    conf = (NeuralNetConfiguration.builder().seed(2)
+            .updater(Adam(learning_rate=1e-3)).l2(1e-4).list()
+            .layer(Bidirectional(layer=LSTM(n_out=6), mode="concat"))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(3)).build())
+    m1 = MultiLayerNetwork(conf).init()
+    v = m1.params()
+    assert v.size == m1.num_params()
+    from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+    m2 = MultiLayerNetwork(
+        MultiLayerConfiguration.from_json(conf.to_json())).init(seed=77)
+    m2.set_params(v)
+    x = np.random.default_rng(1).normal(size=(2, 5, 3)).astype(np.float32)
+    assert np.allclose(np.asarray(m1.output(x)), np.asarray(m2.output(x)),
+                       atol=1e-6)
+    assert "Bidirectional" in m1.summary()
+    # l2 regularization reaches the nested weights
+    assert float(m1._regularization_score(m1.params_tree)) > 0.0
+
+
+def test_bidirectional_json_round_trip():
+    from deeplearning4j_tpu.nn.conf.base import layer_from_dict
+    bd = Bidirectional(layer=LSTM(n_in=4, n_out=8, activation="tanh"),
+                       mode="add")
+    bd2 = layer_from_dict(bd.to_dict())
+    assert isinstance(bd2.layer, LSTM)
+    assert bd2.mode == "add" and bd2.layer.n_out == 8
