@@ -17,21 +17,16 @@ capabilities the reference lacks (SURVEY.md §2.3 marks TP/PP/SP absent).
 
 from deeplearning4j_tpu.parallel.mesh import MeshConfig
 from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.distributed import (
+    global_mesh, host_local_batch_to_global, initialize)
+from deeplearning4j_tpu.parallel.checkpoint import (
+    CheckpointListener, ShardedCheckpointer)
 
-__all__ = ["MeshConfig", "ShardedTrainer", "initialize_distributed"]
+# DL4J-familiar alias: `initialize_distributed` ≙ Spark/Aeron bring-up
+initialize_distributed = initialize
 
-
-def initialize_distributed(coordinator_address=None, num_processes=None,
-                           process_id=None):
-    """Multi-host control plane (replaces Spark driver + Aeron mesh
-    handshake): a thin wrapper over ``jax.distributed.initialize`` so the
-    same sharded train step spans hosts over DCN."""
-    import jax
-    kwargs = {}
-    if coordinator_address is not None:
-        kwargs["coordinator_address"] = coordinator_address
-    if num_processes is not None:
-        kwargs["num_processes"] = num_processes
-    if process_id is not None:
-        kwargs["process_id"] = process_id
-    jax.distributed.initialize(**kwargs)
+__all__ = ["MeshConfig", "ShardedTrainer", "ParallelInference",
+           "initialize", "initialize_distributed", "global_mesh",
+           "host_local_batch_to_global", "ShardedCheckpointer",
+           "CheckpointListener"]

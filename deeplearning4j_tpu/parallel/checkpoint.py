@@ -1,0 +1,102 @@
+"""Sharded, preemption-safe checkpointing (orbax-backed).
+
+The multi-host complement to ``utils.model_serializer`` (which writes one
+host-side zip): saves the FULL training state — params, optimizer state,
+model state, step/epoch counters — with each process writing its own
+shards, async so the train loop isn't blocked, keep-K rotation like DL4J's
+``CheckpointListener`` (reference:
+``org.deeplearning4j.optimize.listeners.CheckpointListener`` keepLast/
+logSaving; SURVEY.md §5.3-5.4 'checkpoint-restart driven' elasticity).
+"""
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class ShardedCheckpointer:
+    """``save(step, state)`` / ``restore_latest(like)`` with keep-K
+    rotation and async writes (preemption safety: the previous save
+    completes or is discarded atomically by orbax)."""
+
+    def __init__(self, directory, keep_last: int = 3, async_save: bool = True):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=keep_last,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=opts)
+
+    def save(self, step: int, state: Any, metrics: Optional[dict] = None,
+             force: bool = False):
+        self._mgr.save(int(step), args=ocp.args.StandardSave(state),
+                       metrics=metrics, force=force)
+
+    def restore_latest(self, like: Any):
+        """Restore the newest step into the structure of `like` (sharded
+        arrays are restored with their shardings).  Returns (step, state)
+        or (None, None) when no checkpoint exists."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None, None
+        state = self._mgr.restore(step, args=ocp.args.StandardRestore(like))
+        return step, state
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def wait(self):
+        """Block until pending async saves land (call before exit)."""
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+
+class CheckpointListener(TrainingListener):
+    """Every-N-iterations / every-N-epochs checkpointing listener — the
+    DL4J ``CheckpointListener`` surface on the sharded checkpointer."""
+
+    def __init__(self, directory, save_every_n_iterations: Optional[int] = None,
+                 save_every_n_epochs: Optional[int] = None, keep_last: int = 3):
+        self.ckpt = ShardedCheckpointer(directory, keep_last=keep_last)
+        self.every_iter = save_every_n_iterations
+        self.every_epoch = save_every_n_epochs
+
+    def _state(self, model):
+        return {"params": model.params_tree,
+                "opt_state": model.opt_state,
+                "model_state": model.state_tree,
+                "counters": {"iteration": model.iteration_count,
+                             "epoch": model.epoch_count}}
+
+    def iteration_done(self, model, iteration, epoch, loss):
+        if self.every_iter and iteration > 0 and \
+                iteration % self.every_iter == 0:
+            self.ckpt.save(iteration, self._state(model),
+                           metrics={"loss": float(loss)})
+
+    def on_epoch_end(self, model, epoch):
+        if self.every_epoch and (epoch + 1) % self.every_epoch == 0:
+            self.ckpt.save(model.iteration_count, self._state(model))
+
+    def restore_into(self, model):
+        """Resume a model in place from the newest checkpoint; returns the
+        restored step or None."""
+        step, state = self.ckpt.restore_latest(self._state(model))
+        if step is None:
+            return None
+        model.params_tree = state["params"]
+        model.opt_state = state["opt_state"]
+        model.state_tree = state["model_state"]
+        model.iteration_count = int(state["counters"]["iteration"])
+        model.epoch_count = int(state["counters"]["epoch"])
+        return step

@@ -1,0 +1,136 @@
+"""ParallelInference: dynamic-batching inference server.
+
+Parity with ``org.deeplearning4j.parallelism.ParallelInference`` (scaleout
+module): concurrent callers' requests are queued, coalesced up to
+``batch_limit``, run through one compiled forward, and scattered back.
+
+TPU-first difference: DL4J replicates the model across device threads and
+round-robins; here ONE jitted apply serves everything (XLA pipelines
+back-to-back launches), with bucketed padding so each distinct batch size
+doesn't force a recompile.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bucket(n: int, limit: int) -> int:
+    """Next power-of-two bucket (≤ limit) — bounds compile count at
+    log2(limit) variants."""
+    b = 1
+    while b < n and b < limit:
+        b *= 2
+    return min(b, limit)
+
+
+class _Request:
+    __slots__ = ("x", "event", "result", "error")
+
+    def __init__(self, x):
+        self.x = x
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class ParallelInference:
+    """``ParallelInference.output(x)`` is thread-safe and blocking; a
+    background worker batches concurrent requests.
+
+    queue_limit / batch_limit mirror the DL4J builder knobs
+    (``.inferenceMode(BATCHED).batchLimit(..).queueLimit(..)``)."""
+
+    def __init__(self, model, batch_limit: int = 64, queue_limit: int = 64,
+                 timeout_ms: float = 2.0):
+        self.model = model
+        model._check_init()
+        self.batch_limit = int(batch_limit)
+        self.timeout = timeout_ms / 1000.0
+        self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue(
+            maxsize=queue_limit)
+        self._apply = jax.jit(model._forward_infer)
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._shutdown = False
+        self._worker.start()
+
+    def output(self, x) -> np.ndarray:
+        """Blocking single-example (or small-batch) inference."""
+        if self._shutdown:
+            raise RuntimeError("ParallelInference has been shut down")
+        req = _Request(np.asarray(x))
+        self._queue.put(req)
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def shutdown(self):
+        self._shutdown = True
+        self._queue.put(None)
+        self._worker.join(timeout=5)
+
+    # ------------------------------------------------------------------
+    def _drain(self):
+        """Collect requests until batch_limit examples or a lull."""
+        first = self._queue.get()
+        if first is None:
+            return None
+        reqs = [first]
+        n = first.x.shape[0] if first.x.ndim > 1 else 1
+        while n < self.batch_limit:
+            try:
+                r = self._queue.get(timeout=self.timeout)
+            except queue.Empty:
+                break
+            if r is None:
+                self._queue.put(None)  # re-post sentinel for the loop
+                break
+            reqs.append(r)
+            n += r.x.shape[0] if r.x.ndim > 1 else 1
+        return reqs
+
+    def _run(self):
+        while True:
+            reqs = self._drain()
+            if reqs is None:
+                return
+            try:
+                feats = [r.x if r.x.ndim > 1 else r.x[None] for r in reqs]
+                sizes = [f.shape[0] for f in feats]
+                batch = np.concatenate(feats, axis=0)
+                n = batch.shape[0]
+                b = _bucket(n, max(self.batch_limit, n))
+                if b > n:  # pad to the bucket to bound recompiles
+                    pad = np.zeros((b - n,) + batch.shape[1:], batch.dtype)
+                    batch = np.concatenate([batch, pad], axis=0)
+                out = self._apply(self.model.params_tree,
+                                  self.model.state_tree,
+                                  jnp.asarray(batch))
+                if isinstance(out, dict):  # ComputationGraph outputs
+                    outs = self.model.conf.network_outputs
+                    out = out[outs[0]] if len(outs) == 1 else out
+                out = np.asarray(out)[:n]
+                off = 0
+                for r, s in zip(reqs, sizes):
+                    res = out[off:off + s]
+                    r.result = res if r.x.ndim > 1 else res[0]
+                    off += s
+            except Exception as e:  # surface to every blocked caller
+                for r in reqs:
+                    r.error = e
+            finally:
+                for r in reqs:
+                    r.event.set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

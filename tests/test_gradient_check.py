@@ -1,0 +1,141 @@
+"""Gradient-check harness over the layer zoo — the parity analogue of
+upstream ``GradientCheckTests`` / ``CNNGradientCheckTest`` /
+``LSTMGradientCheckTests`` (all built on GradientCheckUtil)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ComputationGraph, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers_conv import (
+    BatchNormalization, ConvolutionLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.layers_core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers_recurrent import (
+    GravesLSTM, LSTM, RnnOutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Sgd
+from deeplearning4j_tpu.utils.gradient_check import check_model_gradients
+
+
+def _build(layers, input_type, seed=12):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(Sgd(learning_rate=0.1)).list())
+    for ly in layers:
+        b.layer(ly)
+    return MultiLayerNetwork(b.set_input_type(input_type).build()).init()
+
+
+def _cls_ds(rng, shape, n_cls, seq=False):
+    x = rng.normal(size=shape).astype(np.float64)
+    if seq:
+        lab = rng.integers(0, n_cls, (shape[0], shape[1]))
+    else:
+        lab = rng.integers(0, n_cls, shape[0])
+    return DataSet(x, np.eye(n_cls)[lab].astype(np.float64))
+
+
+def test_dense_mlp_gradients(rng):
+    model = _build([DenseLayer(n_out=12, activation="tanh"),
+                    DenseLayer(n_out=8, activation="sigmoid"),
+                    OutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+                   InputType.feed_forward(6))
+    res = check_model_gradients(model, _cls_ds(rng, (5, 6), 3),
+                                max_per_param=16)
+    assert res.passed, res.failures[:5]
+    assert res.n_checked > 0
+
+
+def test_dense_l1_l2_gradients(rng):
+    b = (NeuralNetConfiguration.builder().seed(4)
+         .updater(Sgd(learning_rate=0.1)).l1(0.02).l2(0.05).list()
+         .layer(DenseLayer(n_out=10, activation="relu"))
+         .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+         .set_input_type(InputType.feed_forward(6)))
+    model = MultiLayerNetwork(b.build()).init()
+    res = check_model_gradients(model, _cls_ds(rng, (5, 6), 3),
+                                max_per_param=16)
+    assert res.passed, res.failures[:5]
+
+
+def test_conv_bn_pool_gradients(rng):
+    model = _build([ConvolutionLayer(kernel_size=(3, 3), n_out=4,
+                                     activation="tanh",
+                                     convolution_mode="same"),
+                    BatchNormalization(),
+                    SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                     pooling_type="max"),
+                    OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                   InputType.convolutional(8, 8, 2))
+    res = check_model_gradients(model, _cls_ds(rng, (4, 8, 8, 2), 2),
+                                max_per_param=12)
+    assert res.passed, res.failures[:5]
+
+
+def test_lstm_gradients(rng):
+    model = _build([LSTM(n_out=7),
+                    RnnOutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent")],
+                   InputType.recurrent(5))
+    res = check_model_gradients(model, _cls_ds(rng, (3, 6, 5), 3, seq=True),
+                                max_per_param=12)
+    assert res.passed, res.failures[:5]
+
+
+def test_graves_lstm_masked_gradients(rng):
+    model = _build([GravesLSTM(n_out=6),
+                    RnnOutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent")],
+                   InputType.recurrent(4))
+    ds = _cls_ds(rng, (3, 5, 4), 3, seq=True)
+    mask = np.ones((3, 5))
+    mask[0, 3:] = 0
+    mask[2, 2:] = 0
+    ds.features_mask = mask
+    ds.labels_mask = mask.copy()
+    res = check_model_gradients(model, ds, max_per_param=12)
+    assert res.passed, res.failures[:5]
+
+
+def test_graph_residual_gradients(rng):
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(Sgd(learning_rate=0.1))
+            .graph().add_inputs("in")
+            .set_input_types(InputType.feed_forward(6))
+            .add_layer("d1", DenseLayer(n_out=10, activation="tanh"), "in")
+            .add_layer("d2", DenseLayer(n_out=10, activation="tanh"), "d1")
+            .add_vertex("res", ElementWiseVertex("add"), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "res")
+            .set_outputs("out").build())
+    model = ComputationGraph(conf).init()
+    res = check_model_gradients(model, _cls_ds(rng, (4, 6), 3),
+                                max_per_param=16)
+    assert res.passed, res.failures[:5]
+
+
+def test_detects_wrong_gradient(rng):
+    """The harness must FAIL when the analytic gradient is wrong — probe
+    with a loss whose forward is deliberately non-matching (stop_gradient
+    kink)."""
+    import jax
+    model = _build([DenseLayer(n_out=8, activation="relu"),
+                    OutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+                   InputType.feed_forward(6))
+    orig = model._score_batch
+
+    def broken(params, state, batch, rng_, training):
+        loss, st = orig(params, state, batch, rng_, training)
+        w = params["layer_0"]["W"]
+        # contributes to the value but not the gradient
+        return loss + 0.1 * jax.lax.stop_gradient(jnp_sum_sq(w)), st
+
+    import jax.numpy as jnp
+
+    def jnp_sum_sq(w):
+        return jnp.sum(jnp.square(w))
+
+    model._score_batch = broken
+    res = check_model_gradients(model, _cls_ds(rng, (4, 6), 3),
+                                max_per_param=8)
+    assert not res.passed
