@@ -318,6 +318,7 @@ class ComputationGraph:
         self.listeners: List[TrainingListener] = []
         self.iteration_count = 0
         self.epoch_count = 0
+        self.last_batch_size = 0
         self._rng = RngKeyManager(conf.global_conf.seed)
         self._dtype = canonical_dtype(conf.global_conf.dtype)
         cd = getattr(conf.global_conf, "compute_dtype", None)
@@ -375,17 +376,22 @@ class ComputationGraph:
     def _forward_all(self, params, state, inputs: Dict[str, Any], training,
                      rng, masks: Optional[Dict[str, Any]] = None,
                      stop_before_output: bool = False):
-        """Topological walk; returns (activations dict, new_state, masks).
-        ``stop_before_output=True`` leaves output-layer vertices at their
-        PRE-activation inputs (training path computes loss from logits)."""
+        """Topological walk; returns (activations dict, new_state, masks,
+        head_inputs).  With ``stop_before_output=True``, ``head_inputs``
+        maps each output-layer vertex to the activation FEEDING it (the
+        training path computes loss from logits); ``acts`` still holds the
+        real output activation whenever a downstream vertex consumes it,
+        so consumers never see pre-output values."""
         acts: Dict[str, Any] = dict(inputs)
         act_masks: Dict[str, Any] = dict(masks or {})
+        head_inputs: Dict[str, Any] = {}
         new_state = dict(state)
         layer_names = [n for n, _ in self._layer_vertices()]
         keys = (dict(zip(layer_names,
                          jax.random.split(rng, max(len(layer_names), 1))))
                 if rng is not None else {})
         out_set = set(self.conf.network_outputs) if stop_before_output else set()
+        consumed = {i for ins in self.conf.vertex_inputs.values() for i in ins}
         for name in self.conf.topological_order:
             spec = self.conf.vertices[name]
             xs = [acts[i] for i in self.conf.vertex_inputs[name]]
@@ -402,8 +408,12 @@ class ComputationGraph:
                 if spec.preprocessor is not None:
                     x = spec.preprocessor(x)
                 if name in out_set:
-                    acts[name] = x  # hidden activation feeding the loss head
-                    continue
+                    head_inputs[name] = x
+                    if name not in consumed:
+                        acts[name] = x
+                        continue
+                    # fall through: a downstream vertex reads this output
+                    # layer's real activation during training too
                 ly = spec.layer
                 kwargs = {"mask": mask} if getattr(ly, "USES_MASK", False) \
                     else {}
@@ -416,13 +426,13 @@ class ComputationGraph:
                 acts[name] = spec.vertex.apply(xs)
             if mask is not None:
                 act_masks[name] = mask
-        return acts, new_state, act_masks
+        return acts, new_state, act_masks, head_inputs
 
     def _forward_infer(self, params, state, inputs, masks=None):
         """Inference forward; returns dict of output-vertex activations."""
         inputs = self._as_input_dict(inputs)
-        acts, _, _ = self._forward_all(params, state, inputs, False, None,
-                                       masks=masks)
+        acts, _, _, _ = self._forward_all(params, state, inputs, False, None,
+                                          masks=masks)
         return {n: acts[n] for n in self.conf.network_outputs}
 
     def _regularization_score(self, params):
@@ -458,7 +468,7 @@ class ComputationGraph:
             lmasks = {}
         elif not isinstance(lmasks, dict):
             lmasks = {self.conf.network_outputs[0]: lmasks}
-        acts, new_state, _ = self._forward_all(
+        acts, new_state, _, head_inputs = self._forward_all(
             params, state, inputs, training, rng, masks=fmasks,
             stop_before_output=True)
         loss = 0.0
@@ -467,7 +477,7 @@ class ComputationGraph:
             if not isinstance(out_layer, BaseOutputLayerConf):
                 raise ValueError(
                     f"Output vertex {name!r} must be an output/loss layer")
-            z = out_layer.pre_output(params[name], acts[name],
+            z = out_layer.pre_output(params[name], head_inputs[name],
                                      self._compute_dtype)
             lmask = lmasks.get(name)
             scores = out_layer.per_example_score(labels[name], z, lmask)
@@ -550,21 +560,29 @@ class ComputationGraph:
                    if async_prefetch and not isinstance(
                        iterator, AsyncDataSetIterator)
                    else iterator)
+        tbptt = (self.conf.backprop_type == "truncated_bptt"
+                 and self.conf.tbptt_fwd_length)
         last_loss = None
         for _ in range(n_epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch_count)
             for ds in wrapped:
-                batch = self._batch_dict(ds)
-                (self.params_tree, self.opt_state, self.state_tree,
-                 loss) = self._solver.step(
-                    self.params_tree, self.opt_state, self.state_tree,
-                    self.iteration_count, batch, self._rng.next_key())
-                last_loss = loss
-                for lst in self.listeners:
-                    lst.iteration_done(self, self.iteration_count,
-                                       self.epoch_count, loss)
-                self.iteration_count += 1
+                self.last_batch_size = ds.num_examples()
+                chunks = (self._tbptt_chunks(ds, self.conf.tbptt_fwd_length)
+                          if tbptt else [ds])
+                for chunk in chunks:
+                    batch = self._batch_dict(chunk)
+                    (self.params_tree, self.opt_state, self.state_tree,
+                     loss) = self._solver.step(
+                        self.params_tree, self.opt_state, self.state_tree,
+                        self.iteration_count, batch, self._rng.next_key())
+                    last_loss = loss
+                    for lst in self.listeners:
+                        lst.iteration_done(self, self.iteration_count,
+                                           self.epoch_count, loss)
+                    self.iteration_count += 1
+                # Recurrent carry flows ACROSS tBPTT chunks of one batch
+                # (that is truncated BPTT) but never across batches.
                 if self._has_rnn():
                     self.rnn_clear_previous_state()
             self.epoch_count += 1
@@ -607,6 +625,11 @@ class ComputationGraph:
 
         return _Step()
 
+    @staticmethod
+    def _tbptt_chunks(ds: Union[DataSet, MultiDataSet], length: int):
+        from deeplearning4j_tpu.data.dataset import tbptt_segments
+        return tbptt_segments(ds, length)
+
     # ------------------------------------------------------------------
     # Recurrent state (DL4J ComputationGraph.rnnTimeStep analogues)
     # ------------------------------------------------------------------
@@ -638,7 +661,7 @@ class ComputationGraph:
             masks = {k: jnp.asarray(v) for k, v in
                      self._as_input_dict(features_mask).items()}
         if training:
-            acts, _, _ = self._forward_all(
+            acts, _, _, _ = self._forward_all(
                 self.params_tree, self.state_tree, ins, True,
                 self._rng.next_key(), masks=masks)
             outs = {n: acts[n] for n in self.conf.network_outputs}
@@ -654,8 +677,8 @@ class ComputationGraph:
         ins = {k: jnp.asarray(v)
                for k, v in self._as_input_dict(inputs).items()}
         rng = self._rng.next_key() if training else None
-        acts, _, _ = self._forward_all(self.params_tree, self.state_tree,
-                                       ins, training, rng)
+        acts, _, _, _ = self._forward_all(self.params_tree, self.state_tree,
+                                          ins, training, rng)
         return acts
 
     def score(self, ds: Union[DataSet, MultiDataSet]) -> float:

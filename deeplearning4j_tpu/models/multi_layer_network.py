@@ -262,17 +262,8 @@ class MultiLayerNetwork:
     def _tbptt_chunks(ds: DataSet, length: int):
         """Split a sequence DataSet along time into tBPTT segments
         (DL4J ``MultiLayerNetwork.doTruncatedBPTT``)."""
-        t = ds.features.shape[1]
-        out = []
-        for start in range(0, t, length):
-            sl = slice(start, min(start + length, t))
-            out.append(DataSet(
-                ds.features[:, sl],
-                ds.labels[:, sl] if ds.labels.ndim == 3 else ds.labels,
-                None if ds.features_mask is None else ds.features_mask[:, sl],
-                None if ds.labels_mask is None else ds.labels_mask[:, sl],
-            ))
-        return out
+        from deeplearning4j_tpu.data.dataset import tbptt_segments
+        return tbptt_segments(ds, length)
 
     def rnn_clear_previous_state(self):
         """Drop stored recurrent carries (DL4J ``rnnClearPreviousState``)."""

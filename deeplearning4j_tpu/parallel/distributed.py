@@ -40,8 +40,6 @@ def initialize(coordinator_address: Optional[str] = None,
 
     The Spark+Aeron analogue: this is the ONLY control-plane call; after
     it, ``jax.devices()`` spans every host and collectives are global."""
-    if jax.process_count() > 1:
-        return  # already initialized
     kwargs = {}
     if coordinator_address is not None:
         kwargs = dict(coordinator_address=coordinator_address,
@@ -49,10 +47,21 @@ def initialize(coordinator_address: Optional[str] = None,
         if local_device_ids is not None:
             kwargs["local_device_ids"] = list(local_device_ids)
     try:
+        # Fail LOUDLY when cluster args were given: a multi-host job that
+        # silently degrades to single-process training trains on 1/N of
+        # the data with no warning — the analogue of a Spark worker
+        # dropping out of SharedTrainingMaster unnoticed.
         jax.distributed.initialize(**kwargs)
-    except (RuntimeError, ValueError) as e:
-        # single-process runs (tests, one-host dev) are fine un-initialized
-        log.info("jax.distributed.initialize skipped: %s", e)
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            return  # idempotent, like repeated Nd4j backend init
+        raise
+    except ValueError:
+        if kwargs:
+            raise
+        # Bare initialize() on a single host with no cluster environment:
+        # the documented no-op path (tests, one-host dev).
+        log.info("single-process run: jax.distributed not initialized")
 
 
 def global_mesh(data: Optional[int] = None, model: int = 1,

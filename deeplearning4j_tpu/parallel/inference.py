@@ -114,13 +114,23 @@ class ParallelInference:
                                   jnp.asarray(batch))
                 if isinstance(out, dict):  # ComputationGraph outputs
                     outs = self.model.conf.network_outputs
-                    out = out[outs[0]] if len(outs) == 1 else out
-                out = np.asarray(out)[:n]
-                off = 0
-                for r, s in zip(reqs, sizes):
-                    res = out[off:off + s]
-                    r.result = res if r.x.ndim > 1 else res[0]
-                    off += s
+                    out = out[outs[0]] if len(outs) == 1 else \
+                        [out[name] for name in outs]
+                if isinstance(out, list):  # multi-output graph: per-output
+                    arrs = [np.asarray(a)[:n] for a in out]
+                    off = 0
+                    for r, s in zip(reqs, sizes):
+                        parts = [a[off:off + s] for a in arrs]
+                        r.result = (parts if r.x.ndim > 1
+                                    else [p[0] for p in parts])
+                        off += s
+                else:
+                    out = np.asarray(out)[:n]
+                    off = 0
+                    for r, s in zip(reqs, sizes):
+                        res = out[off:off + s]
+                        r.result = res if r.x.ndim > 1 else res[0]
+                        off += s
             except Exception as e:  # surface to every blocked caller
                 for r in reqs:
                     r.error = e

@@ -85,11 +85,9 @@ class ShardedTrainer:
             out[k] = jax.device_put(jnp.asarray(v), sharding)
         return out
 
-    def fit_batch(self, features, labels, features_mask=None,
-                  labels_mask=None):
-        """One global step: shard inputs, run the compiled step, return
-        loss.  Equivalent to one synchronized ParallelWrapper averaging
-        round — except synchronization is an XLA all-reduce over ICI."""
+    def _step_batch(self, features, labels, features_mask=None,
+                    labels_mask=None):
+        """Run the compiled sharded step WITHOUT touching counters."""
         m = self.model
         batch = {"features": jnp.asarray(features),
                  "labels": jnp.asarray(labels)}
@@ -102,22 +100,45 @@ class ShardedTrainer:
             (m.params_tree, m.opt_state, m.state_tree, loss) = \
                 self.solver.step(m.params_tree, m.opt_state, m.state_tree,
                                  m.iteration_count, batch, m._rng.next_key())
-        m.iteration_count += 1
+        return loss
+
+    def fit_batch(self, features, labels, features_mask=None,
+                  labels_mask=None):
+        """One global step: shard inputs, run the compiled step, return
+        loss.  Equivalent to one synchronized ParallelWrapper averaging
+        round — except synchronization is an XLA all-reduce over ICI."""
+        loss = self._step_batch(features, labels, features_mask, labels_mask)
+        self.model.iteration_count += 1
         return loss
 
     def fit(self, iterator, n_epochs: int = 1):
+        from deeplearning4j_tpu.data.dataset import tbptt_segments
         m = self.model
+        tbptt = (getattr(m.conf, "backprop_type", "standard")
+                 == "truncated_bptt" and m.conf.tbptt_fwd_length)
         last = None
         for _ in range(n_epochs):
             for lst in m.listeners:
                 lst.on_epoch_start(m, m.epoch_count)
             for ds in iterator:
                 m.last_batch_size = ds.num_examples()
-                last = self.fit_batch(ds.features, ds.labels,
-                                      ds.features_mask, ds.labels_mask)
-                for lst in m.listeners:
-                    lst.iteration_done(m, m.iteration_count - 1,
-                                       m.epoch_count, last)
+                chunks = (tbptt_segments(ds, m.conf.tbptt_fwd_length)
+                          if tbptt else [ds])
+                for chunk in chunks:
+                    last = self._step_batch(chunk.features, chunk.labels,
+                                            chunk.features_mask,
+                                            chunk.labels_mask)
+                    # Listeners fire BEFORE the counter increments — the
+                    # same ordering as MultiLayerNetwork.fit, so a
+                    # checkpoint taken in iteration_done records the step
+                    # it was taken at.
+                    for lst in m.listeners:
+                        lst.iteration_done(m, m.iteration_count,
+                                           m.epoch_count, last)
+                    m.iteration_count += 1
+                # Carry flows across tBPTT chunks, never across batches.
+                if m._has_rnn():
+                    m.rnn_clear_previous_state()
             m.epoch_count += 1
             for lst in m.listeners:
                 lst.on_epoch_end(m, m.epoch_count - 1)

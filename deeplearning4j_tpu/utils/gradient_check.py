@@ -69,8 +69,13 @@ def check_model_gradients(
     x64_was = jax.config.read("jax_enable_x64")
     # x64 must be ON before ANY conversion — with it off, jnp silently
     # truncates float64 requests to float32 and the FD probe drowns in
-    # single-precision noise.
+    # single-precision noise.  The model's compute_dtype must ALSO be
+    # forced to f64: layers cast x/W to compute_dtype inside pre_output,
+    # so a float32 compute policy would truncate the probe even with x64
+    # enabled globally.
     jax.config.update("jax_enable_x64", True)
+    compute_was = getattr(model, "_compute_dtype", None)
+    model._compute_dtype = jnp.float64
     try:
         batch = model._batch_dict(ds)
         batch = jax.tree_util.tree_map(
@@ -119,4 +124,5 @@ def check_model_gradients(
                         "/".join(path), int(i), analytic, numeric, rel))
         return GradCheckResult(not failures, max_err, n_checked, failures)
     finally:
+        model._compute_dtype = compute_was
         jax.config.update("jax_enable_x64", x64_was)

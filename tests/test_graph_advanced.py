@@ -1,0 +1,114 @@
+"""Regression tests for ComputationGraph tBPTT, output-vertex fan-out,
+and multi-output ParallelInference (round-2 fixes; parity targets:
+``ComputationGraph.doTruncatedBPTT``, graph forward consistency, and
+``ParallelInference`` with multi-output graphs)."""
+import numpy as np
+
+from deeplearning4j_tpu import ComputationGraph, NeuralNetConfiguration
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers_core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers_recurrent import (
+    LSTM, RnnOutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+
+def _seq_graph(tbptt=None):
+    gb = (NeuralNetConfiguration.builder().seed(3)
+          .updater(Adam(learning_rate=5e-3))
+          .graph()
+          .add_inputs("in")
+          .set_input_types(InputType.recurrent(6))
+          .add_layer("lstm", LSTM(n_out=8, activation="tanh"), "in")
+          .add_layer("out", RnnOutputLayer(n_out=4, activation="softmax",
+                                           loss="mcxent"), "lstm")
+          .set_outputs("out"))
+    if tbptt:
+        gb.backprop_type("truncated_bptt", tbptt)
+    return gb.build()
+
+
+def _seq_xy(rng, b=8, t=12, f=6, c=4):
+    x = rng.normal(size=(b, t, f)).astype(np.float32)
+    y = np.eye(c, dtype=np.float32)[rng.integers(0, c, (b, t))]
+    return x, y
+
+
+def test_graph_tbptt_chunks_and_trains(rng):
+    model = ComputationGraph(_seq_graph(tbptt=4)).init()
+    x, y = _seq_xy(rng, t=12)
+    ds = DataSet(x, y)
+    before = model.score(ds)
+    model.fit(ds)
+    # 12 timesteps / tbptt 4 -> 3 parameter updates for one batch
+    assert model.iteration_count == 3
+    for _ in range(20):
+        model.fit(ds)
+    assert model.score(ds) < before
+
+
+def test_graph_tbptt_matches_mds(rng):
+    model = ComputationGraph(_seq_graph(tbptt=5)).init()
+    x, y = _seq_xy(rng, t=12)
+    mds = MultiDataSet([x], [y])
+    model.fit(mds)
+    # ceil(12/5) = 3 chunks
+    assert model.iteration_count == 3
+
+
+def test_output_layer_feeding_downstream_vertex(rng):
+    """An output layer that also feeds another vertex: the downstream
+    consumer must see the REAL activation during training (not the
+    pre-output input), so inference and training forwards agree."""
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=1e-2))
+            .graph()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(5))
+            .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out1", OutputLayer(n_out=3, activation="softmax",
+                                           loss="mcxent"), "d")
+            .add_vertex("cat", MergeVertex(), "d", "out1")
+            .add_layer("out2", OutputLayer(n_out=2, activation="softmax",
+                                           loss="mcxent"), "cat")
+            .set_outputs("out1", "out2")
+            .build())
+    model = ComputationGraph(conf).init()
+    x = rng.normal(size=(16, 5)).astype(np.float32)
+    y1 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    y2 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    mds = MultiDataSet([x], [y1, y2])
+    before = model.score(mds)
+    assert np.isfinite(before)
+    for _ in range(30):
+        model.fit(mds)
+    assert model.score(mds) < before
+    # training-path activations match inference for the downstream head
+    o1, o2 = model.output(x)
+    assert np.allclose(np.asarray(o1).sum(1), 1.0, atol=1e-5)
+    assert np.allclose(np.asarray(o2).sum(1), 1.0, atol=1e-5)
+
+
+def test_parallel_inference_multi_output(rng):
+    conf = (NeuralNetConfiguration.builder().seed(11)
+            .updater(Adam(learning_rate=1e-2))
+            .graph()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("out1", OutputLayer(n_out=3, activation="softmax",
+                                           loss="mcxent"), "d")
+            .add_layer("out2", OutputLayer(n_out=1, activation="identity",
+                                           loss="mse"), "d")
+            .set_outputs("out1", "out2")
+            .build())
+    model = ComputationGraph(conf).init()
+    x = rng.normal(size=(6, 4)).astype(np.float32)
+    ref1, ref2 = model.output(x)
+    with ParallelInference(model, batch_limit=8) as pi:
+        got = pi.output(x)
+    assert isinstance(got, list) and len(got) == 2
+    assert np.allclose(got[0], np.asarray(ref1), atol=1e-5)
+    assert np.allclose(got[1], np.asarray(ref2), atol=1e-5)
