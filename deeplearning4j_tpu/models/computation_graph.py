@@ -36,6 +36,7 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType, Preprocessor, adapt
 from deeplearning4j_tpu.nn.conf.layers_core import BaseOutputLayerConf
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.optimize.solver import Solver
+from deeplearning4j_tpu.optimize.fit_loop import run_fit
 from deeplearning4j_tpu.optimize.updaters import updater_from_dict
 from deeplearning4j_tpu.runtime.backend import backend
 from deeplearning4j_tpu.runtime.dtype import canonical_dtype
@@ -560,36 +561,16 @@ class ComputationGraph:
                    if async_prefetch and not isinstance(
                        iterator, AsyncDataSetIterator)
                    else iterator)
-        tbptt = (self.conf.backprop_type == "truncated_bptt"
-                 and self.conf.tbptt_fwd_length)
-        last_loss = None
-        for _ in range(n_epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch_count)
-            for ds in wrapped:
-                self.last_batch_size = ds.num_examples()
-                chunks = (self._tbptt_chunks(ds, self.conf.tbptt_fwd_length)
-                          if tbptt else [ds])
-                for chunk in chunks:
-                    batch = self._batch_dict(chunk)
-                    (self.params_tree, self.opt_state, self.state_tree,
-                     loss) = self._solver.step(
-                        self.params_tree, self.opt_state, self.state_tree,
-                        self.iteration_count, batch, self._rng.next_key())
-                    last_loss = loss
-                    for lst in self.listeners:
-                        lst.iteration_done(self, self.iteration_count,
-                                           self.epoch_count, loss)
-                    self.iteration_count += 1
-                # Recurrent carry flows ACROSS tBPTT chunks of one batch
-                # (that is truncated BPTT) but never across batches.
-                if self._has_rnn():
-                    self.rnn_clear_previous_state()
-            self.epoch_count += 1
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch_count - 1)
-            iterator.reset()
-        return None if last_loss is None else float(last_loss)
+
+        def step_fn(batch):
+            (self.params_tree, self.opt_state, self.state_tree,
+             loss) = self._solver.step(
+                self.params_tree, self.opt_state, self.state_tree,
+                self.iteration_count, batch, self._rng.next_key())
+            return loss
+
+        return run_fit(self, wrapped, n_epochs, step_fn,
+                       reset_target=iterator)
 
     def compiled_train_step(self):
         """A reusable jitted full train step operating on a ``TrainState``

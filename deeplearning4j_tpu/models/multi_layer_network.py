@@ -30,6 +30,7 @@ from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.layers_core import BaseOutputLayerConf
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.optimize.solver import Solver
+from deeplearning4j_tpu.optimize.fit_loop import run_fit
 from deeplearning4j_tpu.optimize.updaters import updater_from_dict
 from deeplearning4j_tpu.runtime.backend import backend
 from deeplearning4j_tpu.runtime.dtype import canonical_dtype
@@ -218,39 +219,16 @@ class MultiLayerNetwork:
                    if async_prefetch and not isinstance(
                        iterator, AsyncDataSetIterator)
                    else iterator)
-        tbptt = (self.conf.backprop_type == "truncated_bptt"
-                 and self.conf.tbptt_fwd_length)
-        last_loss = None
-        for _ in range(n_epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch_count)
-            for ds in wrapped:
-                self.last_batch_size = ds.num_examples()
-                chunks = (self._tbptt_chunks(ds, self.conf.tbptt_fwd_length)
-                          if tbptt else [ds])
-                for chunk in chunks:
-                    batch = self._batch_dict(chunk)
-                    (self.params_tree, self.opt_state, self.state_tree,
-                     loss) = self._solver.step(
-                        self.params_tree, self.opt_state, self.state_tree,
-                        self.iteration_count, batch, self._rng.next_key())
-                    last_loss = loss
-                    for lst in self.listeners:
-                        lst.iteration_done(self, self.iteration_count,
-                                           self.epoch_count, loss)
-                    self.iteration_count += 1
-                # Recurrent carry must not leak across independent batches
-                # (within a batch, tBPTT chunks DO carry state — that is
-                # the point of truncated BPTT).
-                if self._has_rnn():
-                    self.rnn_clear_previous_state()
-            # Increment BEFORE listeners so a checkpoint taken in
-            # on_epoch_end records "N epochs completed" and resumes exactly.
-            self.epoch_count += 1
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch_count - 1)
-            iterator.reset()
-        return None if last_loss is None else float(last_loss)
+
+        def step_fn(batch):
+            (self.params_tree, self.opt_state, self.state_tree,
+             loss) = self._solver.step(
+                self.params_tree, self.opt_state, self.state_tree,
+                self.iteration_count, batch, self._rng.next_key())
+            return loss
+
+        return run_fit(self, wrapped, n_epochs, step_fn,
+                       reset_target=iterator)
 
     # ------------------------------------------------------------------
     # Recurrent state management (DL4J rnnTimeStep / tBPTT semantics)

@@ -113,6 +113,11 @@ class BaseLayerConf:
             self.weight_decay = global_conf.weight_decay
         if self.dropout is None:
             self.dropout = global_conf.dropout
+        # Fail at BUILD time on an unknown activation name, not at first
+        # forward (DL4J's enum gives the same eager guarantee).
+        if self.activation is not None:
+            from deeplearning4j_tpu.nn.activations import get_activation
+            get_activation(self.activation)
 
 
 @dataclasses.dataclass

@@ -135,7 +135,6 @@ class ConvolutionLayer(BaseLayerConf):
         z = self._conv(x, w)
         if self.has_bias:
             z = z + params["b"].astype(z.dtype)
-        z = z.astype(params["W"].dtype)
         y = get_activation(self.activation or "identity")(z)
         return apply_dropout(y, self.dropout, training, rng), state
 
@@ -238,7 +237,6 @@ class DepthwiseConvolution2D(BaseLayerConf):
             feature_group_count=self.n_in)
         if self.has_bias:
             z = z + params["b"].astype(z.dtype)
-        z = z.astype(params["W"].dtype)
         y = get_activation(self.activation or "identity")(z)
         return apply_dropout(y, self.dropout, training, rng), state
 
@@ -290,7 +288,6 @@ class SeparableConvolution2D(DepthwiseConvolution2D):
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.has_bias:
             z = z + params["b"].astype(z.dtype)
-        z = z.astype(params["W"].dtype)
         y = get_activation(self.activation or "identity")(z)
         return apply_dropout(y, self.dropout, training, rng), state
 
@@ -357,7 +354,6 @@ class Convolution1DLayer(BaseLayerConf):
             rhs_dilation=(d,), dimension_numbers=("NTC", "TIO", "NTC"))
         if self.has_bias:
             z = z + params["b"].astype(z.dtype)
-        z = z.astype(params["W"].dtype)
         y = get_activation(self.activation or "identity")(z)
         return apply_dropout(y, self.dropout, training, rng), state
 
@@ -475,19 +471,35 @@ class BatchNormalization(BaseLayerConf):
     def apply(self, params, state, x, *, training: bool, rng=None,
               compute_dtype=None):
         axes = tuple(range(x.ndim - 1))
+        # Statistics accumulate at >=f32 even when activations are bf16
+        # (the convert fuses into the reduction); f64 inputs keep f64 so
+        # gradient checks stay full-precision.
+        stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
         if training and not self.use_global_stats:
-            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
-            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            xf = x.astype(stat_dtype)
+            mean = jnp.mean(xf, axis=axes)
+            # E[x^2]-E[x]^2: sibling reductions fuse into ONE pass over x.
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean), 0.0)
             d = self.decay
-            new_state = {"mean": d * state["mean"] + (1 - d) * mean,
-                         "var": d * state["var"] + (1 - d) * var}
+            new_state = {
+                "mean": (d * state["mean"] + (1 - d) * mean).astype(jnp.float32),
+                "var": (d * state["var"] + (1 - d) * var).astype(jnp.float32)}
         else:
-            mean, var = state["mean"], state["var"]
+            mean = state["mean"].astype(stat_dtype)
+            var = state["var"].astype(stat_dtype)
             new_state = state
+        # Fold (x-mean)*inv*gamma+beta into one FMA per element: scale and
+        # offset are [C]-sized f32 vectors, the big tensor is touched once
+        # in its own (bf16) dtype — the cuDNN-style fused BN on TPU terms.
         inv = lax.rsqrt(var + self.eps)
-        y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
         if not self.lock_gamma_beta:
-            y = y * params["gamma"] + params["beta"]
+            scale = params["gamma"].astype(stat_dtype) * inv
+            offset = params["beta"].astype(stat_dtype) - mean * scale
+        else:
+            scale = inv
+            offset = -mean * inv
+        y = x * scale.astype(x.dtype) + offset.astype(x.dtype)
         y = get_activation(self.activation or "identity")(y)
         return y, new_state
 

@@ -77,7 +77,10 @@ class DenseLayer(BaseLayerConf):
         z = x @ w
         if self.has_bias:
             z = z + params["b"].astype(z.dtype)
-        return z.astype(params["W"].dtype)
+        # Activations STAY in compute dtype (bf16 on TPU): casting back up
+        # per layer doubles HBM traffic for every downstream elementwise op.
+        # Loss heads promote to >=f32 (see per_example_score).
+        return z
 
     def apply(self, params, state, x, *, training: bool, rng=None,
               compute_dtype=None):
@@ -166,6 +169,10 @@ class BaseOutputLayerConf(BaseLayerConf):
         act = (self.activation or "identity").lower()
         loss_name = str(self.loss).lower()
         loss_fn = get_loss(loss_name)
+        # Scores are computed at >=f32 regardless of the activation dtype
+        # policy (bf16 softmax/CE is numerically unsafe); f64 stays f64 so
+        # the gradient-check harness keeps full precision.
+        z = z.astype(jnp.promote_types(z.dtype, jnp.float32))
 
         seq = z.ndim == 3
         if seq:
@@ -200,6 +207,7 @@ class OutputLayer(BaseOutputLayerConf, DenseLayer):
     def apply(self, params, state, x, *, training: bool, rng=None,
               compute_dtype=None):
         z = self.pre_output(params, x, compute_dtype)
+        z = z.astype(jnp.promote_types(z.dtype, jnp.float32))
         return get_activation(self.activation or "identity")(z), state
 
 

@@ -1,0 +1,53 @@
+"""The one epoch/iteration loop shared by every training entry point.
+
+DL4J triplicated this control flow across ``MultiLayerNetwork.fit``,
+``ComputationGraph.fit`` and ``ParallelWrapper.fit``; here the loop —
+epoch listeners, tBPTT segmentation, iteration listeners firing BEFORE the
+counter increments (so checkpoints record the step they were taken at),
+recurrent-carry clearing between batches — lives once, parameterized by
+the step function (plain solver step, or the sharded-mesh step).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+def run_fit(model, iterator, n_epochs: int, step_fn: Callable,
+            reset_target=None) -> Optional[float]:
+    """Drive ``step_fn(batch_dict) -> loss`` over an iterator for
+    ``n_epochs``.  ``model`` supplies listeners/counters/_batch_dict;
+    ``reset_target`` is the iterator whose ``reset()`` is called at epoch
+    end (the unwrapped iterator when async prefetch is stacked on top)."""
+    from deeplearning4j_tpu.data.dataset import tbptt_segments
+
+    tbptt_len = (model.conf.tbptt_fwd_length
+                 if getattr(model.conf, "backprop_type", "standard")
+                 == "truncated_bptt" else 0)
+    last_loss = None
+    for _ in range(n_epochs):
+        for lst in model.listeners:
+            lst.on_epoch_start(model, model.epoch_count)
+        for ds in iterator:
+            model.last_batch_size = ds.num_examples()
+            chunks = tbptt_segments(ds, tbptt_len) if tbptt_len else [ds]
+            for chunk in chunks:
+                loss = step_fn(model._batch_dict(chunk))
+                last_loss = loss
+                # Listeners fire BEFORE the counter increments, so a
+                # checkpoint taken in iteration_done records the step it
+                # was taken at and resume agrees exactly.
+                for lst in model.listeners:
+                    lst.iteration_done(model, model.iteration_count,
+                                       model.epoch_count, loss)
+                model.iteration_count += 1
+            # Recurrent carry flows ACROSS tBPTT chunks of one batch (that
+            # is the point of truncated BPTT) but never across batches.
+            if model._has_rnn():
+                model.rnn_clear_previous_state()
+        # Increment BEFORE epoch listeners so a checkpoint taken in
+        # on_epoch_end records "N epochs completed" and resumes exactly.
+        model.epoch_count += 1
+        for lst in model.listeners:
+            lst.on_epoch_end(model, model.epoch_count - 1)
+        (reset_target if reset_target is not None else iterator).reset()
+    return None if last_loss is None else float(last_loss)

@@ -53,8 +53,17 @@ def initialize(coordinator_address: Optional[str] = None,
         # dropping out of SharedTrainingMaster unnoticed.
         jax.distributed.initialize(**kwargs)
     except RuntimeError as e:
-        if "already initialized" in str(e).lower():
+        msg = str(e).lower()
+        # jax's actual wording is "should only be called once"; keep the
+        # "already initialized" match for older/newer phrasings.
+        if "only be called once" in msg or "already initialized" in msg:
             return  # idempotent, like repeated Nd4j backend init
+        if not kwargs and "before any jax" in msg:
+            # Bare initialize() after jax was already used in-process on a
+            # single host: nothing to join, documented no-op path.
+            log.info("single-process run: jax already in use; "
+                     "distributed not initialized")
+            return
         raise
     except ValueError:
         if kwargs:

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.optimize.fit_loop import run_fit
 from deeplearning4j_tpu.parallel.mesh import MeshConfig
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -75,32 +76,35 @@ class ShardedTrainer:
             jax.tree_util.tree_map(lambda a: self._replicated,
                                    model.state_tree))
     def _shard_batch(self, batch: dict) -> dict:
-        out = {}
-        for k, v in batch.items():
-            nd = np.ndim(v)
-            parts = [None] * nd
-            if self.mesh_conf.data > 1 and nd >= 1:
+        """Place every batch leaf (arrays, possibly nested per-input dicts
+        for multi-input graphs) batch-sharded over the 'data' axis."""
+        def place(v):
+            v = jnp.asarray(v)
+            parts = [None] * v.ndim
+            if self.mesh_conf.data > 1 and v.ndim >= 1:
                 parts[0] = "data"
-            sharding = NamedSharding(self.mesh, P(*parts))
-            out[k] = jax.device_put(jnp.asarray(v), sharding)
-        return out
+            return jax.device_put(v, NamedSharding(self.mesh, P(*parts)))
+        return jax.tree_util.tree_map(place, batch)
 
-    def _step_batch(self, features, labels, features_mask=None,
-                    labels_mask=None):
-        """Run the compiled sharded step WITHOUT touching counters."""
+    def _step_dict(self, batch: dict):
+        """Run the compiled sharded step on a prepared batch dict WITHOUT
+        touching counters."""
         m = self.model
-        batch = {"features": jnp.asarray(features),
-                 "labels": jnp.asarray(labels)}
-        if features_mask is not None:
-            batch["features_mask"] = jnp.asarray(features_mask)
-        if labels_mask is not None:
-            batch["labels_mask"] = jnp.asarray(labels_mask)
         batch = self._shard_batch(batch)
         with self.mesh:
             (m.params_tree, m.opt_state, m.state_tree, loss) = \
                 self.solver.step(m.params_tree, m.opt_state, m.state_tree,
                                  m.iteration_count, batch, m._rng.next_key())
         return loss
+
+    def _step_batch(self, features, labels, features_mask=None,
+                    labels_mask=None):
+        batch = {"features": features, "labels": labels}
+        if features_mask is not None:
+            batch["features_mask"] = features_mask
+        if labels_mask is not None:
+            batch["labels_mask"] = labels_mask
+        return self._step_dict(batch)
 
     def fit_batch(self, features, labels, features_mask=None,
                   labels_mask=None):
@@ -112,35 +116,7 @@ class ShardedTrainer:
         return loss
 
     def fit(self, iterator, n_epochs: int = 1):
-        from deeplearning4j_tpu.data.dataset import tbptt_segments
-        m = self.model
-        tbptt = (getattr(m.conf, "backprop_type", "standard")
-                 == "truncated_bptt" and m.conf.tbptt_fwd_length)
-        last = None
-        for _ in range(n_epochs):
-            for lst in m.listeners:
-                lst.on_epoch_start(m, m.epoch_count)
-            for ds in iterator:
-                m.last_batch_size = ds.num_examples()
-                chunks = (tbptt_segments(ds, m.conf.tbptt_fwd_length)
-                          if tbptt else [ds])
-                for chunk in chunks:
-                    last = self._step_batch(chunk.features, chunk.labels,
-                                            chunk.features_mask,
-                                            chunk.labels_mask)
-                    # Listeners fire BEFORE the counter increments — the
-                    # same ordering as MultiLayerNetwork.fit, so a
-                    # checkpoint taken in iteration_done records the step
-                    # it was taken at.
-                    for lst in m.listeners:
-                        lst.iteration_done(m, m.iteration_count,
-                                           m.epoch_count, last)
-                    m.iteration_count += 1
-                # Carry flows across tBPTT chunks, never across batches.
-                if m._has_rnn():
-                    m.rnn_clear_previous_state()
-            m.epoch_count += 1
-            for lst in m.listeners:
-                lst.on_epoch_end(m, m.epoch_count - 1)
-            iterator.reset()
-        return None if last is None else float(last)
+        """Drive an iterator through the sharded step — the same shared
+        epoch loop as MultiLayerNetwork/ComputationGraph.fit, so tBPTT,
+        MultiDataSet batches, listener ordering and counters agree."""
+        return run_fit(self.model, iterator, n_epochs, self._step_dict)

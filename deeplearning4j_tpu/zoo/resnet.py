@@ -18,7 +18,8 @@ from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers_conv import (
-    BatchNormalization, ConvolutionLayer, SubsamplingLayer, ZeroPaddingLayer)
+    BatchNormalization, ConvolutionLayer, GlobalPoolingLayer,
+    SubsamplingLayer, ZeroPaddingLayer)
 from deeplearning4j_tpu.nn.conf.layers_core import ActivationLayer, OutputLayer
 from deeplearning4j_tpu.optimize.updaters import AdaDelta
 from deeplearning4j_tpu.zoo.base import ZooModel
@@ -87,8 +88,10 @@ class ResNet50(ZooModel):
             for blk in range(blocks):
                 x = self._bottleneck(g, stage, blk, x, filters,
                                      stride if blk == 0 else (1, 1))
-        g.add_layer("avgpool", SubsamplingLayer(
-            kernel_size=(7, 7), stride=(7, 7), pooling_type="avg"), x)
+        # Global mean-reduce, not a 7x7 windowed pool: same numbers on the
+        # 7x7 final feature map, but XLA lowers a plain reduce far better
+        # than reduce_window on TPU.
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
         g.add_layer("output", OutputLayer(
             n_out=self.n_classes, activation="softmax", loss="mcxent"),
             "avgpool")

@@ -95,3 +95,32 @@ def test_graft_entry_dryrun():
     out = jax.jit(fn)(*args)
     assert np.isfinite(np.asarray(out)).all()
     ge.dryrun_multichip(8)
+
+
+def test_sharded_tbptt_multidataset_graph():
+    """Regression: ShardedTrainer.fit over a truncated-BPTT graph fed
+    MultiDataSet batches must segment time and step without error (the
+    round-1 loop read DataSet-only attributes off MultiDataSet chunks)."""
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers_recurrent import LSTM, RnnOutputLayer
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 12, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (8, 12))]
+    g = (NeuralNetConfiguration.builder().seed(1)
+         .updater(Adam(learning_rate=1e-2)).graph()
+         .add_inputs("in").set_input_types(InputType.recurrent(6))
+         .add_layer("lstm", LSTM(n_out=8), "in")
+         .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "lstm")
+         .set_outputs("out")
+         .backprop_type("truncated_bptt", 4))
+    model = ComputationGraph(g.build()).init()
+    trainer = ShardedTrainer(model, MeshConfig(data=4))
+    it = ListDataSetIterator([MultiDataSet([x], [y])])
+    loss = trainer.fit(it, n_epochs=2)
+    assert np.isfinite(loss)
+    # 12 timesteps / tbptt 4 = 3 chunks per batch, 2 epochs
+    assert model.iteration_count == 6
