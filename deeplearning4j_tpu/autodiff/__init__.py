@@ -1,0 +1,15 @@
+"""Graph IR + autodiff: the SameDiff pillar, TPU-first.
+
+Reference: ``org.nd4j.autodiff.samediff.SameDiff`` (define-by-run recorded
+DAG, interpreted op-by-op by ``InferenceSession``/``TrainingSession``) and
+its FlatBuffers serialization.  Here the recorded DAG *traces into one XLA
+program* — the interpreter, its dep-tracking queue, and the per-op JNI
+crossings do not exist.  Gradients come from ``jax.grad`` over the traced
+function instead of a hand-maintained reverse-mode graph.
+"""
+from deeplearning4j_tpu.autodiff.ops import OP_REGISTRY, register_op
+from deeplearning4j_tpu.autodiff.samediff import (
+    SameDiff, SDVariable, TrainingConfig)
+
+__all__ = ["SameDiff", "SDVariable", "TrainingConfig", "OP_REGISTRY",
+           "register_op"]

@@ -1,0 +1,452 @@
+"""Op registry for the graph IR.
+
+The analogue of libnd4j's ``DeclarableOp``/``OpRegistrator`` (~500 named
+ops, reference ``libnd4j/include/ops/declarable/**``) and the JVM op
+classes (``org.nd4j.linalg.api.ops.**``) — except every op here is a thin
+jax/lax lowering, so "registering an op" is one function, not a C++ kernel
+pair plus shape function plus JavaCPP binding.
+
+Static/constant folding: ops whose inputs are all host values (numpy
+arrays, ints) execute with numpy at TRACE time.  This is how TF graphs'
+shape-metaprogramming subgraphs (Shape → StridedSlice → Pack → Reshape)
+become static under jit: ``shape`` always returns a host np.int64 vector
+(XLA shapes are static), and everything derived from it stays host-side,
+so Reshape/Tile/etc. see concrete targets — compiler-friendly control
+flow with no data-dependent shapes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@dataclasses.dataclass
+class OpDef:
+    name: str
+    fn: Callable  # fn(*inputs, **attrs) -> output or tuple of outputs
+    n_out: int = 1
+    # Ops that must run host-side (return static values) even on traced
+    # inputs, because they only read shape metadata:
+    static: bool = False
+
+
+OP_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, n_out: int = 1, static: bool = False):
+    def deco(fn):
+        OP_REGISTRY[name] = OpDef(name=name, fn=fn, n_out=n_out, static=static)
+        return fn
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    op = OP_REGISTRY.get(name)
+    if op is None:
+        raise KeyError(
+            f"Unknown op {name!r}; registered: {sorted(OP_REGISTRY)}")
+    return op
+
+
+def is_static_value(v) -> bool:
+    """True when `v` is a host value (safe to constant-fold with numpy)."""
+    return isinstance(v, (int, float, bool, np.ndarray, np.generic, list,
+                          tuple))
+
+
+def _xp(*args):
+    """numpy when all inputs are host values (constant folding), else jnp."""
+    return np if all(is_static_value(a) for a in args) else jnp
+
+
+# ---------------------------------------------------------------------------
+# Elementwise binary (broadcasting)
+# ---------------------------------------------------------------------------
+for _name, _f in [
+    ("add", lambda m: m.add), ("sub", lambda m: m.subtract),
+    ("mul", lambda m: m.multiply), ("div", lambda m: m.divide),
+    ("floordiv", lambda m: m.floor_divide), ("mod", lambda m: m.mod),
+    ("pow", lambda m: m.power), ("maximum", lambda m: m.maximum),
+    ("minimum", lambda m: m.minimum),
+    ("squared_difference", lambda m: (lambda a, b: m.square(a - b))),
+]:
+    def _make(f):
+        def impl(a, b):
+            m = _xp(a, b)
+            return f(m)(a, b)
+        return impl
+    register_op(_name)(_make(_f))
+
+for _name, _f in [
+    ("equal", lambda m: m.equal), ("not_equal", lambda m: m.not_equal),
+    ("greater", lambda m: m.greater), ("less", lambda m: m.less),
+    ("greater_equal", lambda m: m.greater_equal),
+    ("less_equal", lambda m: m.less_equal),
+    ("logical_and", lambda m: m.logical_and),
+    ("logical_or", lambda m: m.logical_or),
+]:
+    def _make_cmp(f):
+        def impl(a, b):
+            m = _xp(a, b)
+            return f(m)(a, b)
+        return impl
+    register_op(_name)(_make_cmp(_f))
+
+
+# ---------------------------------------------------------------------------
+# Elementwise unary
+# ---------------------------------------------------------------------------
+for _name, _jf in [
+    ("neg", jnp.negative), ("abs", jnp.abs), ("sign", jnp.sign),
+    ("exp", jnp.exp), ("log", jnp.log), ("log1p", jnp.log1p),
+    ("sqrt", jnp.sqrt), ("rsqrt", lambda x: lax.rsqrt(x)),
+    ("square", jnp.square), ("reciprocal", jnp.reciprocal),
+    ("floor", jnp.floor), ("ceil", jnp.ceil), ("round", jnp.round),
+    ("sin", jnp.sin), ("cos", jnp.cos), ("tan", jnp.tan),
+    ("tanh", jnp.tanh), ("sigmoid", jax.nn.sigmoid), ("erf", lax.erf),
+    ("relu", jax.nn.relu), ("relu6", jax.nn.relu6), ("elu", jax.nn.elu),
+    ("selu", jax.nn.selu), ("softplus", jax.nn.softplus),
+    ("softsign", jax.nn.soft_sign), ("logical_not", jnp.logical_not),
+    ("isnan", jnp.isnan), ("isinf", jnp.isinf),
+]:
+    register_op(_name)(lambda x, _f=_jf: _f(x))
+
+register_op("identity")(lambda x: x)
+register_op("stop_gradient")(lambda x: x if is_static_value(x)
+                             else lax.stop_gradient(x))
+register_op("erfc")(lambda x: lax.erfc(x))
+register_op("leaky_relu")(lambda x, alpha=0.2: jax.nn.leaky_relu(x, alpha))
+register_op("gelu")(lambda x, approximate=True: jax.nn.gelu(x, approximate=approximate))
+register_op("clip_by_value")(lambda x, lo, hi: jnp.clip(x, lo, hi))
+register_op("cast")(lambda x, dtype: (np.asarray(x).astype(dtype)
+                                      if is_static_value(x)
+                                      else x.astype(dtype)))
+
+
+# ---------------------------------------------------------------------------
+# Matmul family — the MXU path
+# ---------------------------------------------------------------------------
+@register_op("matmul")
+def _matmul(a, b, transpose_a=False, transpose_b=False):
+    """2-D+ matmul (``Mmul``/TF MatMul/BatchMatMulV2 in one: jnp batches)."""
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register_op("tensordot")
+def _tensordot(a, b, axes=2):
+    return jnp.tensordot(a, b, axes=axes)
+
+
+@register_op("bias_add")
+def _bias_add(x, b):
+    return x + b
+
+
+# ---------------------------------------------------------------------------
+# Reductions
+# ---------------------------------------------------------------------------
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (np.ndarray, list, tuple)):
+        seq = np.asarray(axis).reshape(-1).tolist()
+        return tuple(int(a) for a in seq)
+    return int(axis)
+
+
+for _name, _f in [("reduce_sum", "sum"), ("reduce_mean", "mean"),
+                  ("reduce_max", "max"), ("reduce_min", "min"),
+                  ("reduce_prod", "prod"), ("reduce_any", "any"),
+                  ("reduce_all", "all")]:
+    def _make_red(fname):
+        def impl(x, axis=None, keep_dims=False):
+            m = _xp(x)
+            return getattr(m, fname)(x, axis=_norm_axis(axis),
+                                     keepdims=bool(keep_dims))
+        return impl
+    register_op(_name)(_make_red(_f))
+
+register_op("argmax")(lambda x, axis=-1: jnp.argmax(x, axis=_norm_axis(axis)))
+register_op("argmin")(lambda x, axis=-1: jnp.argmin(x, axis=_norm_axis(axis)))
+register_op("cumsum")(lambda x, axis=0: jnp.cumsum(x, axis=int(axis)))
+
+
+# ---------------------------------------------------------------------------
+# Shape metaprogramming (static: constant-folds at trace time)
+# ---------------------------------------------------------------------------
+@register_op("shape", static=True)
+def _shape(x):
+    """XLA shapes are static — return a HOST vector so downstream
+    Pack/StridedSlice/Reshape stay constant under jit (the TF-import
+    equivalent of SameDiff's shape functions)."""
+    return np.asarray(np.shape(x) if is_static_value(x) else x.shape,
+                      dtype=np.int64)
+
+
+@register_op("size", static=True)
+def _size(x):
+    return np.int64(np.prod(np.shape(x) if is_static_value(x) else x.shape))
+
+
+@register_op("rank", static=True)
+def _rank(x):
+    return np.int64(len(np.shape(x) if is_static_value(x) else x.shape))
+
+
+@register_op("reshape")
+def _reshape(x, shape):
+    shape = tuple(int(s) for s in np.asarray(shape).reshape(-1))
+    m = _xp(x)
+    return m.reshape(x, shape)
+
+
+@register_op("transpose")
+def _transpose(x, perm=None):
+    if perm is not None:
+        perm = tuple(int(p) for p in np.asarray(perm).reshape(-1))
+    m = _xp(x)
+    return m.transpose(x, perm)
+
+
+@register_op("expand_dims")
+def _expand_dims(x, axis=0):
+    return _xp(x).expand_dims(x, int(axis))
+
+
+@register_op("squeeze")
+def _squeeze(x, axis=None):
+    ax = _norm_axis(axis)
+    return _xp(x).squeeze(x, axis=ax)
+
+
+@register_op("concat")
+def _concat(*xs, axis=0):
+    return _xp(*xs).concatenate(xs, axis=int(axis))
+
+
+@register_op("pack")
+def _pack(*xs, axis=0):
+    return _xp(*xs).stack(xs, axis=int(axis))
+
+
+@register_op("unstack", n_out=0)  # variable out count, resolved at build
+def _unstack(x, axis=0, num=None):
+    axis = int(axis)
+    n = num or x.shape[axis]
+    return tuple(jnp.squeeze(s, axis)
+                 for s in jnp.split(x, int(n), axis=axis))
+
+
+@register_op("split", n_out=0)
+def _split(x, num_split, axis=0):
+    return tuple(jnp.split(x, int(num_split), axis=int(axis)))
+
+
+@register_op("tile")
+def _tile(x, multiples):
+    multiples = tuple(int(m) for m in np.asarray(multiples).reshape(-1))
+    return _xp(x).tile(x, multiples)
+
+
+@register_op("slice")
+def _slice(x, begin, size):
+    begin = [int(b) for b in np.asarray(begin).reshape(-1)]
+    size = [int(s) for s in np.asarray(size).reshape(-1)]
+    idx = tuple(slice(b, None if s == -1 else b + s)
+                for b, s in zip(begin, size))
+    return x[idx]
+
+
+@register_op("strided_slice")
+def _strided_slice(x, begin, end, strides=None, begin_mask=0, end_mask=0,
+                   ellipsis_mask=0, new_axis_mask=0, shrink_axis_mask=0):
+    """TF StridedSlice semantics subset (no ellipsis/new-axis masks —
+    the BERT graph doesn't produce them)."""
+    if ellipsis_mask or new_axis_mask:
+        raise NotImplementedError("ellipsis/new_axis masks unsupported")
+    begin = [int(b) for b in np.asarray(begin).reshape(-1)]
+    end = [int(e) for e in np.asarray(end).reshape(-1)]
+    strides = ([int(s) for s in np.asarray(strides).reshape(-1)]
+               if strides is not None else [1] * len(begin))
+    idx = []
+    for i in range(len(begin)):
+        b = None if (begin_mask >> i) & 1 else begin[i]
+        e = None if (end_mask >> i) & 1 else end[i]
+        if (shrink_axis_mask >> i) & 1:
+            idx.append(begin[i])
+        else:
+            idx.append(slice(b, e, strides[i]))
+    return x[tuple(idx)]
+
+
+@register_op("gather")
+def _gather(params, indices, axis=0, batch_dims=0):
+    m = _xp(params, indices)
+    if batch_dims:
+        return jnp.take_along_axis(params, indices, axis=int(axis))
+    return m.take(params, np.asarray(indices) if m is np else indices,
+                  axis=int(axis))
+
+
+@register_op("gather_nd")
+def _gather_nd(params, indices):
+    idx = tuple(jnp.moveaxis(indices, -1, 0))
+    return params[idx]
+
+
+@register_op("scatter_nd")
+def _scatter_nd(indices, updates, shape):
+    shape = tuple(int(s) for s in np.asarray(shape).reshape(-1))
+    z = jnp.zeros(shape, updates.dtype)
+    idx = tuple(jnp.moveaxis(indices, -1, 0))
+    return z.at[idx].add(updates)
+
+
+@register_op("one_hot")
+def _one_hot(indices, depth, on_value=1.0, off_value=0.0, axis=-1,
+             dtype="float32"):
+    oh = jax.nn.one_hot(indices, int(depth), axis=int(axis), dtype=dtype)
+    if on_value != 1.0 or off_value != 0.0:
+        oh = oh * (on_value - off_value) + off_value
+    return oh
+
+
+@register_op("fill")
+def _fill(shape, value):
+    shape = tuple(int(s) for s in np.asarray(shape).reshape(-1))
+    if is_static_value(value):
+        return np.full(shape, value)
+    return jnp.full(shape, value)
+
+
+@register_op("zeros_like")
+def _zeros_like(x):
+    return _xp(x).zeros_like(x)
+
+
+@register_op("ones_like")
+def _ones_like(x):
+    return _xp(x).ones_like(x)
+
+
+@register_op("range", static=True)
+def _range(start, limit, delta=1):
+    return np.arange(int(start), int(limit), int(delta))
+
+
+@register_op("pad")
+def _pad(x, paddings, constant_value=0.0):
+    pads = [tuple(int(v) for v in row)
+            for row in np.asarray(paddings).reshape(-1, 2)]
+    return jnp.pad(x, pads, constant_values=constant_value)
+
+
+@register_op("broadcast_to")
+def _broadcast_to(x, shape):
+    shape = tuple(int(s) for s in np.asarray(shape).reshape(-1))
+    return _xp(x).broadcast_to(x, shape)
+
+
+@register_op("where")
+def _where(cond, a, b):
+    return _xp(cond, a, b).where(cond, a, b)
+
+
+@register_op("select")
+def _select(cond, a, b):
+    return _xp(cond, a, b).where(cond, a, b)
+
+
+# ---------------------------------------------------------------------------
+# NN ops
+# ---------------------------------------------------------------------------
+@register_op("softmax")
+def _softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+@register_op("log_softmax")
+def _log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+@register_op("softmax_cross_entropy_with_logits")
+def _sce(labels, logits):
+    return -jnp.sum(labels * jax.nn.log_softmax(logits, -1), axis=-1)
+
+
+@register_op("sparse_softmax_cross_entropy_with_logits")
+def _ssce(labels, logits):
+    lp = jax.nn.log_softmax(logits, -1)
+    return -jnp.take_along_axis(
+        lp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _bce(labels, logits):
+    return jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
+
+
+@register_op("layer_norm")
+def _layer_norm(x, gamma, beta, axis=-1, eps=1e-12):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * gamma + beta
+
+
+@register_op("dropout")
+def _dropout(x, rate=0.0):
+    # Inference graphs import dropout as identity (the TF graph freezes
+    # keep_prob=1); training uses the framework's own dropout plumbing.
+    return x
+
+
+@register_op("l2_normalize")
+def _l2_normalize(x, axis=-1, eps=1e-12):
+    return x * lax.rsqrt(jnp.maximum(
+        jnp.sum(jnp.square(x), axis=axis, keepdims=True), eps))
+
+
+@register_op("embedding_lookup")
+def _embedding_lookup(table, ids):
+    return jnp.take(table, ids, axis=0)
+
+
+@register_op("conv2d")
+def _conv2d(x, w, strides=(1, 1), padding="SAME", dilations=(1, 1)):
+    if isinstance(padding, (bytes, str)):
+        pad = padding.decode() if isinstance(padding, bytes) else padding
+    else:
+        pad = [tuple(p) for p in padding]
+    return lax.conv_general_dilated(
+        x, w, window_strides=tuple(int(s) for s in strides), padding=pad,
+        rhs_dilation=tuple(int(d) for d in dilations),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@register_op("max_pool")
+def _max_pool(x, ksize=(2, 2), strides=(2, 2), padding="VALID"):
+    k, s = tuple(int(v) for v in ksize), tuple(int(v) for v in strides)
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, *k, 1), (1, *s, 1),
+                             padding)
+
+
+@register_op("avg_pool")
+def _avg_pool(x, ksize=(2, 2), strides=(2, 2), padding="VALID"):
+    k, s = tuple(int(v) for v in ksize), tuple(int(v) for v in strides)
+    summed = lax.reduce_window(x, 0.0, lax.add, (1, *k, 1), (1, *s, 1),
+                               padding)
+    ones = jnp.ones(x.shape[1:3] + (1,), x.dtype)[None]
+    counts = lax.reduce_window(ones, 0.0, lax.add, (1, *k, 1), (1, *s, 1),
+                               padding)
+    return summed / counts

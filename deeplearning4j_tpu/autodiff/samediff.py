@@ -1,0 +1,435 @@
+"""SameDiff-equivalent: serializable define-by-run graph IR.
+
+Parity target: ``org.nd4j.autodiff.samediff.SameDiff`` (the ~12-kLoC JVM
+class), ``SDVariable``, ``TrainingConfig``, and the FlatBuffers
+``SameDiff.save/load`` format (SURVEY.md §2.2, §3.3).
+
+TPU-first redesign, not a port:
+
+* DL4J's ``InferenceSession``/``TrainingSession`` interpret the DAG
+  op-by-op (dep-tracking queue, one JNI crossing per op — SURVEY §3.3 "HOT
+  LOOP").  Here ``output``/``fit`` TRACE the recorded graph into a single
+  jitted XLA program; the topological walk happens once at trace time.
+* Reverse-mode: DL4J maintains a mirrored gradient graph (per-op
+  ``doDiff``).  Here gradients are ``jax.grad`` of the traced function —
+  there is no gradient graph to build, serialize, or get out of sync.
+* Serialization is a zip of ``graph.json`` (structure) + ``values.npz``
+  (VARIABLE/CONSTANT arrays) instead of FlatBuffers.
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import zipfile
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.ops import get_op, is_static_value
+from deeplearning4j_tpu.optimize.updaters import (
+    BaseUpdater, updater_from_dict)
+
+VAR_TYPES = ("VARIABLE", "CONSTANT", "PLACEHOLDER", "ARRAY")
+
+
+def _clean_attr(v):
+    """JSON-safe attrs (TF import hands us np arrays/bytes/dtypes)."""
+    if isinstance(v, bytes):
+        return v.decode()
+    if isinstance(v, (np.ndarray, np.generic)):
+        return np.asarray(v).tolist()
+    if isinstance(v, (list, tuple)):
+        return [_clean_attr(x) for x in v]
+    if isinstance(v, np.dtype):
+        return v.name
+    return v
+
+
+@dataclasses.dataclass
+class SDVariable:
+    """A named symbol in the graph (``org.nd4j.autodiff.samediff
+    .SDVariable``): VARIABLE (trainable), CONSTANT, PLACEHOLDER (fed), or
+    ARRAY (op output)."""
+
+    sd: "SameDiff"
+    name: str
+    var_type: str
+    shape: Optional[Sequence[int]] = None
+    dtype: str = "float32"
+
+    # -- ergonomic operator sugar (SDVariable.add/mul/... in DL4J) --
+    def _bin(self, op, other, reverse=False):
+        other = self.sd._as_var(other)
+        a, b = (other, self) if reverse else (self, other)
+        return self.sd.op(op, a, b)
+
+    def __add__(self, o):
+        return self._bin("add", o)
+
+    def __radd__(self, o):
+        return self._bin("add", o, True)
+
+    def __sub__(self, o):
+        return self._bin("sub", o)
+
+    def __rsub__(self, o):
+        return self._bin("sub", o, True)
+
+    def __mul__(self, o):
+        return self._bin("mul", o)
+
+    def __rmul__(self, o):
+        return self._bin("mul", o, True)
+
+    def __truediv__(self, o):
+        return self._bin("div", o)
+
+    def __matmul__(self, o):
+        return self._bin("matmul", o)
+
+    def __neg__(self):
+        return self.sd.op("neg", self)
+
+    def eval(self, feeds: Optional[Dict[str, Any]] = None):
+        return self.sd.output(feeds or {}, [self.name])[self.name]
+
+
+@dataclasses.dataclass
+class OpNode:
+    op_name: str
+    inputs: List[str]
+    outputs: List[str]
+    attrs: Dict[str, Any]
+
+    def to_dict(self):
+        return {"op": self.op_name, "inputs": self.inputs,
+                "outputs": self.outputs,
+                "attrs": {k: _clean_attr(v) for k, v in self.attrs.items()}}
+
+
+@dataclasses.dataclass
+class TrainingConfig:
+    """``org.nd4j.autodiff.samediff.TrainingConfig`` analogue: updater,
+    l2, and the mapping from DataSet slots to placeholder names."""
+
+    updater: Union[BaseUpdater, dict]
+    l2: float = 0.0
+    data_set_feature_mapping: Sequence[str] = ()
+    data_set_label_mapping: Sequence[str] = ()
+
+    def resolved_updater(self) -> BaseUpdater:
+        u = self.updater
+        return updater_from_dict(u) if isinstance(u, dict) else u
+
+
+class SameDiff:
+    """The graph container + builder + executor."""
+
+    def __init__(self):
+        self.vars: Dict[str, SDVariable] = {}
+        self.values: Dict[str, np.ndarray] = {}  # VARIABLE + CONSTANT
+        self.ops: List[OpNode] = []  # creation order == topological order
+        self.loss_variables: List[str] = []
+        self.training_config: Optional[TrainingConfig] = None
+        self._updater_state = None
+        self._step = 0
+        self._fn_cache: Dict[Any, Any] = {}
+
+    @staticmethod
+    def create() -> "SameDiff":
+        return SameDiff()
+
+    # ------------------------------------------------------------------
+    # Variable creation
+    # ------------------------------------------------------------------
+    def _unique(self, base: str) -> str:
+        if base not in self.vars:
+            return base
+        i = 1
+        while f"{base}_{i}" in self.vars:
+            i += 1
+        return f"{base}_{i}"
+
+    def _register(self, name, var_type, shape=None, dtype="float32"):
+        v = SDVariable(self, name, var_type,
+                       tuple(shape) if shape is not None else None,
+                       str(dtype))
+        self.vars[name] = v
+        return v
+
+    def placeholder(self, name: str, shape=None, dtype="float32") -> SDVariable:
+        return self._register(self._unique(name), "PLACEHOLDER", shape, dtype)
+
+    def var(self, name: str, value=None, shape=None, dtype="float32",
+            initializer: str = "zeros", key=None) -> SDVariable:
+        """Trainable variable; give an array, or shape+initializer."""
+        name = self._unique(name)
+        if value is None:
+            if initializer == "zeros":
+                value = np.zeros(shape, dtype)
+            elif initializer == "ones":
+                value = np.ones(shape, dtype)
+            elif initializer == "normal":
+                k = key if key is not None else jax.random.key(0)
+                value = np.asarray(jax.random.normal(k, shape, dtype))
+            else:
+                raise ValueError(f"Unknown initializer {initializer!r}")
+        value = np.asarray(value)
+        self.values[name] = value
+        return self._register(name, "VARIABLE", value.shape, value.dtype.name)
+
+    def constant(self, name: str, value) -> SDVariable:
+        name = self._unique(name)
+        value = np.asarray(value)
+        self.values[name] = value
+        return self._register(name, "CONSTANT", value.shape, value.dtype.name)
+
+    def _as_var(self, x) -> SDVariable:
+        if isinstance(x, SDVariable):
+            return x
+        return self.constant("const", np.asarray(x))
+
+    # ------------------------------------------------------------------
+    # Op recording
+    # ------------------------------------------------------------------
+    def op(self, op_name: str, *inputs, name: Optional[str] = None,
+           n_out: Optional[int] = None, **attrs):
+        """Record one op; returns its SDVariable (or tuple for multi-out).
+        The registry is consulted eagerly so unknown ops fail at build
+        time (the DeclarableOp lookup, minus the JNI)."""
+        opdef = get_op(op_name)
+        in_vars = [self._as_var(x) for x in inputs]
+        n = n_out if n_out is not None else max(opdef.n_out, 1)
+        base = name or op_name
+        outs = [self._unique(base if n == 1 else f"{base}:{i}")
+                for i in range(n)]
+        self.ops.append(OpNode(op_name, [v.name for v in in_vars], outs,
+                               attrs))
+        out_vars = [self._register(o, "ARRAY") for o in outs]
+        self._fn_cache.clear()
+        return out_vars[0] if n == 1 else tuple(out_vars)
+
+    def __getattr__(self, item):
+        # sd.matmul(a, b) sugar for any registered op.
+        from deeplearning4j_tpu.autodiff.ops import OP_REGISTRY
+        if item in OP_REGISTRY:
+            return lambda *a, **kw: self.op(item, *a, **kw)
+        raise AttributeError(item)
+
+    def set_loss_variables(self, *names):
+        self.loss_variables = [n.name if isinstance(n, SDVariable) else n
+                               for n in names]
+
+    # ------------------------------------------------------------------
+    # Execution (trace-to-XLA — replaces InferenceSession's interpreter)
+    # ------------------------------------------------------------------
+    def _run_graph(self, param_vals: Dict[str, Any],
+                   feed_vals: Dict[str, Any], needed: set) -> Dict[str, Any]:
+        env: Dict[str, Any] = {}
+        for k, v in self.values.items():
+            if self.vars[k].var_type == "CONSTANT":
+                env[k] = v  # host value: participates in constant folding
+        env.update(param_vals)
+        env.update(feed_vals)
+        for node in self.ops:
+            if not any(o in needed for o in node.outputs):
+                continue
+            op = get_op(node.op_name)
+            args = [env[i] for i in node.inputs]
+            out = op.fn(*args, **node.attrs)
+            if len(node.outputs) == 1:
+                env[node.outputs[0]] = out
+            else:
+                for o, v in zip(node.outputs, out):
+                    env[o] = v
+        return env
+
+    def _needed_for(self, outputs: Sequence[str]) -> set:
+        """Backward slice: op outputs required to compute `outputs`."""
+        produced_by = {o: node for node in self.ops for o in node.outputs}
+        needed, stack = set(), list(outputs)
+        while stack:
+            n = stack.pop()
+            if n in needed:
+                continue
+            needed.add(n)
+            node = produced_by.get(n)
+            if node is not None:
+                needed.update(node.outputs)
+                stack.extend(node.inputs)
+        return needed
+
+    def _function(self, outputs: Sequence[str], feed_names: Sequence[str]):
+        key = (tuple(outputs), tuple(sorted(feed_names)))
+        if key in self._fn_cache:
+            return self._fn_cache[key]
+        needed = self._needed_for(outputs)
+
+        def fn(params, feeds):
+            env = self._run_graph(params, feeds, needed)
+            missing = [o for o in outputs if o not in env]
+            if missing:
+                raise KeyError(f"Outputs not computed: {missing}")
+            return [env[o] for o in outputs]
+
+        jfn = jax.jit(fn)
+        self._fn_cache[key] = jfn
+        return jfn
+
+    def _param_values(self) -> Dict[str, np.ndarray]:
+        return {k: v for k, v in self.values.items()
+                if self.vars[k].var_type == "VARIABLE"}
+
+    def output(self, feeds: Dict[str, Any],
+               outputs: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+        """Execute and fetch (DL4J ``SameDiff.output(Map, String...)``)."""
+        feeds = {(k.name if isinstance(k, SDVariable) else k): jnp.asarray(v)
+                 for k, v in feeds.items()}
+        if outputs is None:
+            all_outs = {o for n in self.ops for o in n.outputs}
+            consumed = {i for n in self.ops for i in n.inputs}
+            outputs = sorted(all_outs - consumed) or sorted(all_outs)
+        outputs = [o.name if isinstance(o, SDVariable) else o for o in outputs]
+        fn = self._function(outputs, feeds.keys())
+        vals = fn(self._param_values(), feeds)
+        return dict(zip(outputs, vals))
+
+    # ------------------------------------------------------------------
+    # Gradients (jax.grad over the traced loss — no gradient graph)
+    # ------------------------------------------------------------------
+    def _loss_fn(self, feeds_keys, l2=0.0):
+        losses = self.loss_variables
+        if not losses:
+            raise ValueError("set_loss_variables(...) first")
+        needed = self._needed_for(losses)
+
+        def fn(params, feeds):
+            env = self._run_graph(params, feeds, needed)
+            total = 0.0
+            for name in losses:
+                total = total + jnp.mean(env[name])
+            if l2:
+                for v in params.values():
+                    total = total + 0.5 * l2 * jnp.sum(jnp.square(v))
+            return total
+        return fn
+
+    def calculate_gradients(self, feeds: Dict[str, Any],
+                            wrt: Optional[Sequence[str]] = None
+                            ) -> Dict[str, np.ndarray]:
+        feeds = {(k.name if isinstance(k, SDVariable) else k): jnp.asarray(v)
+                 for k, v in feeds.items()}
+        params = self._param_values()
+        grads = jax.jit(jax.grad(self._loss_fn(feeds.keys())))(params, feeds)
+        if wrt is not None:
+            wrt = [w.name if isinstance(w, SDVariable) else w for w in wrt]
+            grads = {k: grads[k] for k in wrt}
+        return grads
+
+    # ------------------------------------------------------------------
+    # Training (TrainingSession analogue: ONE jitted step)
+    # ------------------------------------------------------------------
+    def set_training_config(self, cfg: TrainingConfig):
+        self.training_config = cfg
+
+    def _train_step_fn(self, feed_names):
+        cfg = self.training_config
+        updater = cfg.resolved_updater()
+        loss_fn = self._loss_fn(feed_names, l2=cfg.l2)
+
+        def step(params, opt_state, step_idx, feeds):
+            loss, grads = jax.value_and_grad(loss_fn)(params, feeds)
+            updates, opt_state = updater.update(grads, opt_state, params,
+                                                step_idx)
+            params = jax.tree_util.tree_map(lambda p, u: p - u, params,
+                                            updates)
+            return params, opt_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1)), updater
+
+    def fit(self, data, n_epochs: int = 1):
+        """Train from a DataSet/MultiDataSet iterator using the configured
+        feature/label placeholder mappings (DL4J ``SameDiff.fit``)."""
+        cfg = self.training_config
+        if cfg is None:
+            raise ValueError("set_training_config(...) first")
+        feat_names = list(cfg.data_set_feature_mapping)
+        lab_names = list(cfg.data_set_label_mapping)
+        step_fn, updater = self._train_step_fn(feat_names + lab_names)
+        params = {k: jnp.asarray(v) for k, v in self._param_values().items()}
+        if self._updater_state is None:
+            self._updater_state = updater.init_state(params)
+        losses = []
+        iterator = data if hasattr(data, "__iter__") else [data]
+        for _ in range(n_epochs):
+            for ds in iterator:
+                feats = ds.features if isinstance(ds.features, (list, tuple)) \
+                    else [ds.features]
+                labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
+                    else [ds.labels]
+                feeds = {n: jnp.asarray(a)
+                         for n, a in zip(feat_names + lab_names,
+                                         list(feats) + list(labs))}
+                params, self._updater_state, loss = step_fn(
+                    params, self._updater_state,
+                    jnp.asarray(self._step, jnp.int32), feeds)
+                self._step += 1
+                losses.append(float(loss))
+            if hasattr(data, "reset"):
+                data.reset()
+        for k, v in params.items():
+            self.values[k] = np.asarray(v)
+        return losses
+
+    # ------------------------------------------------------------------
+    # Serialization (zip: graph.json + values.npz — the .fb analogue)
+    # ------------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "format": "deeplearning4j_tpu/samediff-v1",
+            "variables": [
+                {"name": v.name, "type": v.var_type,
+                 "shape": list(v.shape) if v.shape is not None else None,
+                 "dtype": v.dtype}
+                for v in self.vars.values()],
+            "ops": [n.to_dict() for n in self.ops],
+            "loss_variables": self.loss_variables,
+        }
+
+    def save(self, path: str):
+        buf = io.BytesIO()
+        np.savez(buf, **self.values)
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("graph.json", json.dumps(self.to_dict(), indent=1))
+            z.writestr("values.npz", buf.getvalue())
+
+    @staticmethod
+    def load(path: str) -> "SameDiff":
+        sd = SameDiff()
+        with zipfile.ZipFile(path) as z:
+            d = json.loads(z.read("graph.json"))
+            vals = np.load(io.BytesIO(z.read("values.npz")))
+            for v in d["variables"]:
+                sd._register(v["name"], v["type"], v["shape"], v["dtype"])
+            for n in d["ops"]:
+                sd.ops.append(OpNode(n["op"], n["inputs"], n["outputs"],
+                                     n["attrs"]))
+            sd.loss_variables = d.get("loss_variables", [])
+            for k in vals.files:
+                sd.values[k] = vals[k]
+        return sd
+
+    def summary(self) -> str:
+        lines = [f"SameDiff: {len(self.vars)} vars, {len(self.ops)} ops"]
+        for v in self.vars.values():
+            if v.var_type != "ARRAY":
+                lines.append(f"  {v.var_type:<11} {v.name} {v.shape}")
+        counts: Dict[str, int] = {}
+        for n in self.ops:
+            counts[n.op_name] = counts.get(n.op_name, 0) + 1
+        lines.append("  ops: " + ", ".join(
+            f"{k}x{c}" for k, c in sorted(counts.items())))
+        return "\n".join(lines)

@@ -562,15 +562,7 @@ class ComputationGraph:
                        iterator, AsyncDataSetIterator)
                    else iterator)
 
-        def step_fn(batch):
-            (self.params_tree, self.opt_state, self.state_tree,
-             loss) = self._solver.step(
-                self.params_tree, self.opt_state, self.state_tree,
-                self.iteration_count, batch, self._rng.next_key())
-            return loss
-
-        return run_fit(self, wrapped, n_epochs, step_fn,
-                       reset_target=iterator)
+        return run_fit(self, wrapped, n_epochs, reset_target=iterator)
 
     def compiled_train_step(self):
         """A reusable jitted full train step operating on a ``TrainState``

@@ -220,15 +220,7 @@ class MultiLayerNetwork:
                        iterator, AsyncDataSetIterator)
                    else iterator)
 
-        def step_fn(batch):
-            (self.params_tree, self.opt_state, self.state_tree,
-             loss) = self._solver.step(
-                self.params_tree, self.opt_state, self.state_tree,
-                self.iteration_count, batch, self._rng.next_key())
-            return loss
-
-        return run_fit(self, wrapped, n_epochs, step_fn,
-                       reset_target=iterator)
+        return run_fit(self, wrapped, n_epochs, reset_target=iterator)
 
     # ------------------------------------------------------------------
     # Recurrent state management (DL4J rnnTimeStep / tBPTT semantics)

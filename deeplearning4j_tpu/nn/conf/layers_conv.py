@@ -687,6 +687,7 @@ class CnnLossLayer(BaseOutputLayerConf):
 
     def apply(self, params, state, x, *, training: bool, rng=None,
               compute_dtype=None):
+        x = self.promote_head(x)
         return get_activation(self.activation or "identity")(x), state
 
     def pre_output(self, params, x, compute_dtype=None):

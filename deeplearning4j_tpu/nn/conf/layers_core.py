@@ -156,6 +156,13 @@ class BaseOutputLayerConf(BaseLayerConf):
 
     loss: str = "mcxent"
 
+    @staticmethod
+    def promote_head(z):
+        """Loss heads and user-facing head activations run at >=f32
+        (bf16 softmax is numerically unsafe); f64 stays f64 for the
+        gradient-check harness."""
+        return z.astype(jnp.promote_types(z.dtype, jnp.float32))
+
     def per_example_score(self, labels, z, mask=None):
         """Per-example loss from PRE-activation z, fusing softmax/sigmoid
         into the loss when numerically profitable (LossMCXENT's fused path).
@@ -169,10 +176,7 @@ class BaseOutputLayerConf(BaseLayerConf):
         act = (self.activation or "identity").lower()
         loss_name = str(self.loss).lower()
         loss_fn = get_loss(loss_name)
-        # Scores are computed at >=f32 regardless of the activation dtype
-        # policy (bf16 softmax/CE is numerically unsafe); f64 stays f64 so
-        # the gradient-check harness keeps full precision.
-        z = z.astype(jnp.promote_types(z.dtype, jnp.float32))
+        z = self.promote_head(z)
 
         seq = z.ndim == 3
         if seq:
@@ -206,8 +210,7 @@ class OutputLayer(BaseOutputLayerConf, DenseLayer):
 
     def apply(self, params, state, x, *, training: bool, rng=None,
               compute_dtype=None):
-        z = self.pre_output(params, x, compute_dtype)
-        z = z.astype(jnp.promote_types(z.dtype, jnp.float32))
+        z = self.promote_head(self.pre_output(params, x, compute_dtype))
         return get_activation(self.activation or "identity")(z), state
 
 
@@ -218,6 +221,7 @@ class LossLayer(BaseOutputLayerConf):
 
     def apply(self, params, state, x, *, training: bool, rng=None,
               compute_dtype=None):
+        x = self.promote_head(x)
         return get_activation(self.activation or "identity")(x), state
 
     def pre_output(self, params, x, compute_dtype=None):

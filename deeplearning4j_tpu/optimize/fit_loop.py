@@ -12,13 +12,24 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 
-def run_fit(model, iterator, n_epochs: int, step_fn: Callable,
+def run_fit(model, iterator, n_epochs: int,
+            step_fn: Optional[Callable] = None,
             reset_target=None) -> Optional[float]:
     """Drive ``step_fn(batch_dict) -> loss`` over an iterator for
     ``n_epochs``.  ``model`` supplies listeners/counters/_batch_dict;
     ``reset_target`` is the iterator whose ``reset()`` is called at epoch
-    end (the unwrapped iterator when async prefetch is stacked on top)."""
+    end (the unwrapped iterator when async prefetch is stacked on top).
+    Without ``step_fn`` the model's own solver step is used (the plain
+    single-device path); ShardedTrainer passes its mesh step."""
     from deeplearning4j_tpu.data.dataset import tbptt_segments
+
+    if step_fn is None:
+        def step_fn(batch):
+            (model.params_tree, model.opt_state, model.state_tree,
+             loss) = model._solver.step(
+                model.params_tree, model.opt_state, model.state_tree,
+                model.iteration_count, batch, model._rng.next_key())
+            return loss
 
     tbptt_len = (model.conf.tbptt_fwd_length
                  if getattr(model.conf, "backprop_type", "standard")

@@ -58,12 +58,10 @@ def initialize(coordinator_address: Optional[str] = None,
         # "already initialized" match for older/newer phrasings.
         if "only be called once" in msg or "already initialized" in msg:
             return  # idempotent, like repeated Nd4j backend init
-        if not kwargs and "before any jax" in msg:
-            # Bare initialize() after jax was already used in-process on a
-            # single host: nothing to join, documented no-op path.
-            log.info("single-process run: jax already in use; "
-                     "distributed not initialized")
-            return
+        # Anything else (including "must be called before any JAX
+        # computations" on a pod where jax was touched too early) stays
+        # LOUD: a multi-host job silently degrading to one host trains on
+        # 1/N of the data with no warning.
         raise
     except ValueError:
         if kwargs:

@@ -1,0 +1,43 @@
+import os
+os.environ["CUDA_VISIBLE_DEVICES"] = ""
+os.environ["TRANSFORMERS_NO_ADVISORY_WARNINGS"] = "1"
+import numpy as np
+import tensorflow as tf
+
+OUT = os.path.dirname(os.path.abspath(__file__))
+from transformers import BertConfig, TFBertModel
+
+cfg = BertConfig(vocab_size=500, hidden_size=64, num_hidden_layers=2,
+                 num_attention_heads=4, intermediate_size=128,
+                 max_position_embeddings=64, type_vocab_size=2)
+tf.random.set_seed(0)
+model = TFBertModel(cfg)
+B, T = 2, 16
+ids = np.random.default_rng(0).integers(0, 500, (B, T)).astype(np.int32)
+mask = np.ones((B, T), np.int32); mask[1, 10:] = 0
+tt = np.zeros((B, T), np.int32)
+out = model(input_ids=ids, attention_mask=mask, token_type_ids=tt)
+
+from tensorflow.python.framework.convert_to_constants import convert_variables_to_constants_v2
+fn = tf.function(lambda i, m, t: model(input_ids=i, attention_mask=m, token_type_ids=t))
+# Dynamic batch dim: keeps Shape ops in the graph instead of baking
+# B*T into Reshape targets, so the import can run any batch size.
+conc = fn.get_concrete_function(
+    tf.TensorSpec((None, T), tf.int32), tf.TensorSpec((None, T), tf.int32),
+    tf.TensorSpec((None, T), tf.int32))
+frozen = convert_variables_to_constants_v2(conc)
+gd = frozen.graph.as_graph_def()
+ops = sorted({n.op for n in gd.node})
+print("OPS:", ops)
+print("n_nodes:", len(gd.node))
+print("inputs:", [t.name for t in frozen.inputs])
+print("outputs:", [t.name for t in frozen.outputs])
+with open(os.path.join(OUT, "bert_tiny_frozen.pb"), "wb") as f:
+    f.write(gd.SerializeToString())
+np.savez(os.path.join(OUT, "golden.npz"), ids=ids, mask=mask, tt=tt,
+         last_hidden=out.last_hidden_state.numpy(),
+         pooler=out.pooler_output.numpy())
+fo = frozen(tf.constant(ids), tf.constant(mask), tf.constant(tt))
+print("frozen outs:", [o.shape for o in fo])
+np.testing.assert_allclose(fo[0].numpy(), out.last_hidden_state.numpy(), atol=1e-5)
+print("GEN OK")

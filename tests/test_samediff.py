@@ -1,0 +1,127 @@
+"""Graph IR (SameDiff equivalent) tests.
+
+DL4J analogues: SameDiff construction/exec tests in
+``nd4j-tests org.nd4j.autodiff.samediff.*`` — graph build, output, grads
+vs analytic, FlatBuffers round-trip (here zip/JSON), fit convergence.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+
+def test_build_exec_mlp():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (None, 4))
+    rng = np.random.default_rng(0)
+    w = sd.var("w", rng.normal(size=(4, 3)).astype(np.float32))
+    b = sd.var("b", np.zeros(3, np.float32))
+    z = sd.matmul(x, w, name="z")
+    h = sd.op("add", z, b, name="h")
+    y = sd.softmax(h, name="y")
+    xv = rng.normal(size=(5, 4)).astype(np.float32)
+    out = sd.output({"x": xv}, ["y"])["y"]
+    ref = xv @ sd.values["w"] + sd.values["b"]
+    ref = np.exp(ref - ref.max(-1, keepdims=True))
+    ref /= ref.sum(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_operator_sugar_and_eval():
+    sd = SameDiff.create()
+    a = sd.constant("a", np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = sd.constant("b", np.ones((2, 3), np.float32))
+    c = (a + b) * 2.0 - 1.0
+    np.testing.assert_allclose(
+        np.asarray(c.eval()), (np.arange(6).reshape(2, 3) + 1) * 2 - 1)
+
+
+def test_shape_metaprogramming_constant_folds():
+    """Shape -> pack -> reshape stays static under jit (the TF-import
+    pattern: no data-dependent shapes reach XLA)."""
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (2, 3, 4))
+    s = sd.op("shape", x)
+    b = sd.op("strided_slice", s, [0], [1], shrink_axis_mask=1)
+    tgt = sd.op("pack", b, sd.constant("m1", np.int64(-1)))
+    y = sd.reshape(x, tgt, name="flat")
+    xv = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    out = sd.output({"x": xv}, [y.name])[y.name]
+    assert out.shape == (2, 12)
+
+
+def test_gradients_match_analytic():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (8, 4))
+    w = sd.var("w", np.random.default_rng(1).normal(size=(4, 1)).astype(np.float32))
+    pred = sd.matmul(x, w)
+    lab = sd.placeholder("lab", (8, 1))
+    diff = pred - lab
+    loss = sd.reduce_mean(sd.square(diff), name="loss")
+    sd.set_loss_variables(loss)
+    rng = np.random.default_rng(2)
+    xv = rng.normal(size=(8, 4)).astype(np.float32)
+    lv = rng.normal(size=(8, 1)).astype(np.float32)
+    g = sd.calculate_gradients({"x": xv, "lab": lv}, ["w"])["w"]
+    # analytic: dL/dw = 2/N x^T (xw - lab)
+    ref = 2.0 / 8 * xv.T @ (xv @ sd.values["w"] - lv)
+    np.testing.assert_allclose(np.asarray(g), ref, rtol=1e-4)
+
+
+def test_serialization_roundtrip(tmp_path):
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (None, 4))
+    w = sd.var("w", np.random.default_rng(0).normal(size=(4, 2)).astype(np.float32))
+    y = sd.tanh(sd.matmul(x, w), name="out")
+    p = str(tmp_path / "g.sdz")
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    xv = np.random.default_rng(1).normal(size=(3, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(sd.output({"x": xv}, ["out"])["out"]),
+        np.asarray(sd2.output({"x": xv}, ["out"])["out"]), rtol=1e-6)
+
+
+def test_fit_linear_regression_converges():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (None, 3))
+    lab = sd.placeholder("lab", (None, 1))
+    w = sd.var("w", np.zeros((3, 1), np.float32))
+    b = sd.var("b", np.zeros((1,), np.float32))
+    pred = sd.op("add", sd.matmul(x, w), b)
+    loss = sd.reduce_mean(sd.square(pred - lab), name="loss")
+    sd.set_loss_variables(loss)
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(learning_rate=0.1),
+        data_set_feature_mapping=["x"], data_set_label_mapping=["lab"]))
+
+    rng = np.random.default_rng(0)
+    true_w = np.array([[1.5], [-2.0], [0.5]], np.float32)
+    xv = rng.normal(size=(256, 3)).astype(np.float32)
+    yv = xv @ true_w + 0.3
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterator import ListDataSetIterator
+    it = ListDataSetIterator(DataSet(xv, yv).batch_by(64))
+    losses = sd.fit(it, n_epochs=60)
+    assert losses[-1] < 1e-2, losses[-1]
+    np.testing.assert_allclose(sd.values["w"], true_w, atol=0.05)
+
+
+def test_unknown_op_fails_at_build():
+    sd = SameDiff.create()
+    a = sd.constant("a", np.ones(2))
+    with pytest.raises(KeyError):
+        sd.op("definitely_not_an_op", a)
+
+
+def test_multi_output_ops():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (4, 6))
+    parts = sd.op("split", x, n_out=3, num_split=3, axis=1)
+    assert len(parts) == 3
+    back = sd.concat(*parts, axis=1, name="back")
+    xv = np.arange(24, dtype=np.float32).reshape(4, 6)
+    np.testing.assert_allclose(
+        np.asarray(sd.output({"x": xv}, ["back"])["back"]), xv)
