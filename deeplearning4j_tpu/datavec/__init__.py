@@ -1,0 +1,25 @@
+"""DataVec-equivalent ETL: record readers, schema transforms, image pipeline.
+
+Reference: ``datavec/datavec-api org.datavec.api.**`` (RecordReader zoo,
+``TransformProcess`` schema-based column transforms) and
+``datavec-data-image org.datavec.image.recordreader.ImageRecordReader``
+(JavaCV native decode).  TPU-first shape: everything here is HOST-side
+numpy ETL feeding the device via the async-prefetch iterator; decoded
+batches are handed to jax as one contiguous array per batch (one
+device_put, sharded by the trainer), never element-wise.
+"""
+from deeplearning4j_tpu.datavec.records import (
+    CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
+    LineRecordReader, RecordReader)
+from deeplearning4j_tpu.datavec.schema import Schema
+from deeplearning4j_tpu.datavec.transform import TransformProcess
+from deeplearning4j_tpu.datavec.image import ImageRecordReader
+from deeplearning4j_tpu.datavec.iterator import (
+    RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator)
+
+__all__ = [
+    "RecordReader", "CSVRecordReader", "CSVSequenceRecordReader",
+    "LineRecordReader", "CollectionRecordReader", "Schema",
+    "TransformProcess", "ImageRecordReader", "RecordReaderDataSetIterator",
+    "SequenceRecordReaderDataSetIterator",
+]
