@@ -29,18 +29,15 @@ from jax import lax
 class OpDef:
     name: str
     fn: Callable  # fn(*inputs, **attrs) -> output or tuple of outputs
-    n_out: int = 1
-    # Ops that must run host-side (return static values) even on traced
-    # inputs, because they only read shape metadata:
-    static: bool = False
+    n_out: int = 1  # 0 = variable output count; caller must pass n_out
 
 
 OP_REGISTRY: Dict[str, OpDef] = {}
 
 
-def register_op(name: str, n_out: int = 1, static: bool = False):
+def register_op(name: str, n_out: int = 1):
     def deco(fn):
-        OP_REGISTRY[name] = OpDef(name=name, fn=fn, n_out=n_out, static=static)
+        OP_REGISTRY[name] = OpDef(name=name, fn=fn, n_out=n_out)
         return fn
     return deco
 
@@ -183,7 +180,7 @@ register_op("cumsum")(lambda x, axis=0: jnp.cumsum(x, axis=int(axis)))
 # ---------------------------------------------------------------------------
 # Shape metaprogramming (static: constant-folds at trace time)
 # ---------------------------------------------------------------------------
-@register_op("shape", static=True)
+@register_op("shape")
 def _shape(x):
     """XLA shapes are static — return a HOST vector so downstream
     Pack/StridedSlice/Reshape stay constant under jit (the TF-import
@@ -192,12 +189,12 @@ def _shape(x):
                       dtype=np.int64)
 
 
-@register_op("size", static=True)
+@register_op("size")
 def _size(x):
     return np.int64(np.prod(np.shape(x) if is_static_value(x) else x.shape))
 
 
-@register_op("rank", static=True)
+@register_op("rank")
 def _rank(x):
     return np.int64(len(np.shape(x) if is_static_value(x) else x.shape))
 
@@ -290,11 +287,18 @@ def _strided_slice(x, begin, end, strides=None, begin_mask=0, end_mask=0,
 
 @register_op("gather")
 def _gather(params, indices, axis=0, batch_dims=0):
-    m = _xp(params, indices)
-    if batch_dims:
-        return jnp.take_along_axis(params, indices, axis=int(axis))
-    return m.take(params, np.asarray(indices) if m is np else indices,
-                  axis=int(axis))
+    axis, batch_dims = int(axis), int(batch_dims)
+    if batch_dims == 0:
+        m = _xp(params, indices)
+        return m.take(params, np.asarray(indices) if m is np else indices,
+                      axis=axis)
+    # TF GatherV2 batch_dims semantics: the first `batch_dims` axes of
+    # params and indices are matched pairwise; `axis` counts in the FULL
+    # params rank.  vmap over each batch axis, gathering on the residual.
+    fn = lambda p, i: jnp.take(p, i, axis=axis - batch_dims)
+    for _ in range(batch_dims):
+        fn = jax.vmap(fn)
+    return fn(jnp.asarray(params), jnp.asarray(indices))
 
 
 @register_op("gather_nd")
@@ -338,7 +342,7 @@ def _ones_like(x):
     return _xp(x).ones_like(x)
 
 
-@register_op("range", static=True)
+@register_op("range")
 def _range(start, limit, delta=1):
     return np.arange(int(start), int(limit), int(delta))
 

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.autodiff.ops import get_op, is_static_value
+from deeplearning4j_tpu.autodiff.ops import get_op
 from deeplearning4j_tpu.optimize.updaters import (
     BaseUpdater, updater_from_dict)
 
@@ -202,7 +202,11 @@ class SameDiff:
         time (the DeclarableOp lookup, minus the JNI)."""
         opdef = get_op(op_name)
         in_vars = [self._as_var(x) for x in inputs]
-        n = n_out if n_out is not None else max(opdef.n_out, 1)
+        if n_out is None and opdef.n_out == 0:
+            raise ValueError(
+                f"Op {op_name!r} has a variable output count — pass "
+                "n_out= explicitly (e.g. sd.op('split', x, n_out=3, ...))")
+        n = n_out if n_out is not None else opdef.n_out
         base = name or op_name
         outs = [self._unique(base if n == 1 else f"{base}:{i}")
                 for i in range(n)]
@@ -323,7 +327,12 @@ class SameDiff:
         feeds = {(k.name if isinstance(k, SDVariable) else k): jnp.asarray(v)
                  for k, v in feeds.items()}
         params = self._param_values()
-        grads = jax.jit(jax.grad(self._loss_fn(feeds.keys())))(params, feeds)
+        key = ("grad", tuple(self.loss_variables),
+               tuple(sorted(feeds.keys())))
+        if key not in self._fn_cache:
+            self._fn_cache[key] = jax.jit(
+                jax.grad(self._loss_fn(feeds.keys())))
+        grads = self._fn_cache[key](params, feeds)
         if wrt is not None:
             wrt = [w.name if isinstance(w, SDVariable) else w for w in wrt]
             grads = {k: grads[k] for k in wrt}

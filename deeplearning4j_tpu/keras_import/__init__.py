@@ -1,0 +1,12 @@
+"""Keras model import.
+
+Reference: ``deeplearning4j-modelimport
+org.deeplearning4j.nn.modelimport.keras.KerasModelImport`` (~50k LoC of
+per-layer ``KerasLayer`` mappings + weight copying over HDF5).  Here the
+legacy ``.h5`` full-model format (the format DL4J consumed) is parsed
+directly with h5py — config JSON → our layer confs, weight groups → our
+param trees — with no keras runtime needed at import time.
+"""
+from deeplearning4j_tpu.keras_import.keras_import import KerasModelImport
+
+__all__ = ["KerasModelImport"]

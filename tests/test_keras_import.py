@@ -1,0 +1,119 @@
+"""Keras h5 import golden tests: build models with the INSTALLED keras,
+save legacy h5, import, and require elementwise output parity vs
+``model.predict`` — the ``deeplearning4j-modelimport`` golden-file test
+pattern (KerasModelImport h5 fixtures + expected outputs).
+"""
+import os
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cpu_keras():
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "")
+
+
+def _predict(m, x):
+    return np.asarray(m.predict(x, verbose=0))
+
+
+def test_sequential_lenet_parity(tmp_path):
+    from keras import layers
+    m = keras.Sequential([
+        keras.Input((14, 14, 1)),
+        layers.Conv2D(6, 5, activation="relu", name="c1"),
+        layers.MaxPooling2D(2),
+        layers.Conv2D(16, 3, activation="relu", name="c2"),
+        layers.Flatten(),
+        layers.Dense(32, activation="relu", name="fc1"),
+        layers.Dense(10, activation="softmax", name="out"),
+    ])
+    p = str(tmp_path / "lenet.h5")
+    m.save(p)
+
+    from deeplearning4j_tpu.keras_import import KerasModelImport
+    model = KerasModelImport.import_keras_model_and_weights(p)
+    x = np.random.default_rng(0).normal(size=(4, 14, 14, 1)).astype(np.float32)
+    ours = np.asarray(model.output(x))
+    np.testing.assert_allclose(ours, _predict(m, x), atol=1e-5)
+
+
+def test_sequential_batchnorm_running_stats(tmp_path):
+    from keras import layers
+    m = keras.Sequential([
+        keras.Input((8, 8, 2)),
+        layers.Conv2D(4, 3, name="c"),
+        layers.BatchNormalization(name="bn"),
+        layers.Activation("relu"),
+        layers.Flatten(),
+        layers.Dense(3, activation="softmax", name="o"),
+    ])
+    # make running stats non-trivial
+    bn = m.get_layer("bn")
+    bn.moving_mean.assign(np.linspace(-1, 1, 4).astype(np.float32))
+    bn.moving_variance.assign(np.linspace(0.5, 2, 4).astype(np.float32))
+    p = str(tmp_path / "bn.h5")
+    m.save(p)
+
+    from deeplearning4j_tpu.keras_import import KerasModelImport
+    model = KerasModelImport.import_keras_model_and_weights(p)
+    x = np.random.default_rng(1).normal(size=(3, 8, 8, 2)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(model.output(x)),
+                               _predict(m, x), atol=1e-5)
+
+
+def test_sequential_lstm_parity(tmp_path):
+    from keras import layers
+    m = keras.Sequential([
+        keras.Input((6, 5)),
+        layers.LSTM(8, return_sequences=False, name="l1"),
+        layers.Dense(3, activation="softmax", name="o"),
+    ])
+    p = str(tmp_path / "lstm.h5")
+    m.save(p)
+
+    from deeplearning4j_tpu.keras_import import KerasModelImport
+    model = KerasModelImport.import_keras_model_and_weights(p)
+    x = np.random.default_rng(2).normal(size=(4, 6, 5)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(model.output(x)),
+                               _predict(m, x), atol=1e-5)
+
+
+def test_functional_residual_parity(tmp_path):
+    from keras import layers
+    inp = keras.Input((8, 8, 3), name="img")
+    a = layers.Conv2D(4, 3, padding="same", activation="relu",
+                      name="ca")(inp)
+    b = layers.Conv2D(4, 3, padding="same", name="cb")(a)
+    s = layers.Add(name="res")([a, b])
+    r = layers.Activation("relu", name="act")(s)
+    g = layers.GlobalAveragePooling2D(name="gap")(r)
+    out = layers.Dense(5, activation="softmax", name="head")(g)
+    m = keras.Model(inp, out)
+    p = str(tmp_path / "resid.h5")
+    m.save(p)
+
+    from deeplearning4j_tpu.keras_import import KerasModelImport
+    model = KerasModelImport.import_keras_model_and_weights(p)
+    x = np.random.default_rng(3).normal(size=(2, 8, 8, 3)).astype(np.float32)
+    ours = model.output(x)
+    ours = np.asarray(ours["head"] if isinstance(ours, dict) else ours)
+    np.testing.assert_allclose(ours, _predict(m, x), atol=1e-5)
+
+
+def test_import_rejects_unknown_layer(tmp_path):
+    from keras import layers
+    m = keras.Sequential([
+        keras.Input((4,)),
+        layers.Dense(4, activation="relu"),
+        layers.LayerNormalization(),  # not in the supported mapping
+        layers.Dense(2, activation="softmax"),
+    ])
+    p = str(tmp_path / "bad.h5")
+    m.save(p)
+    from deeplearning4j_tpu.keras_import import KerasModelImport
+    with pytest.raises(ValueError, match="LayerNormalization"):
+        KerasModelImport.import_keras_model_and_weights(p)

@@ -125,3 +125,24 @@ def test_multi_output_ops():
     xv = np.arange(24, dtype=np.float32).reshape(4, 6)
     np.testing.assert_allclose(
         np.asarray(sd.output({"x": xv}, ["back"])["back"]), xv)
+
+
+def test_gather_batch_dims_matches_tf_semantics():
+    """GatherV2 batch_dims=1: params [B,L,D], indices [B,K] -> [B,K,D]."""
+    sd = SameDiff.create()
+    p = sd.placeholder("p", (2, 5, 3))
+    i = sd.placeholder("i", (2, 4))
+    g = sd.op("gather", p, i, axis=1, batch_dims=1, name="g")
+    rng = np.random.default_rng(0)
+    pv = rng.normal(size=(2, 5, 3)).astype(np.float32)
+    iv = rng.integers(0, 5, (2, 4)).astype(np.int32)
+    out = np.asarray(sd.output({"p": pv, "i": iv}, ["g"])["g"])
+    ref = np.stack([pv[b][iv[b]] for b in range(2)])
+    np.testing.assert_allclose(out, ref)
+
+
+def test_variable_out_op_requires_n_out():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (4, 6))
+    with pytest.raises(ValueError, match="n_out"):
+        sd.op("split", x, num_split=3, axis=1)
