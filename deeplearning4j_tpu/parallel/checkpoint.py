@@ -71,22 +71,36 @@ class CheckpointListener(TrainingListener):
         self.every_iter = save_every_n_iterations
         self.every_epoch = save_every_n_epochs
 
-    def _state(self, model):
+    def _state(self, model, completed_iterations=None):
+        # counters.iteration stores ITERATIONS COMPLETED: listeners fire
+        # after the update for `iteration` lands but before the counter
+        # increments, so resuming with the raw counter would redo that
+        # step on post-step params and diverge from the uninterrupted
+        # loss trajectory (proven by test_preemption_kill_and_resume).
+        it = (completed_iterations if completed_iterations is not None
+              else model.iteration_count)
         return {"params": model.params_tree,
                 "opt_state": model.opt_state,
                 "model_state": model.state_tree,
-                "counters": {"iteration": model.iteration_count,
+                "counters": {"iteration": it,
                              "epoch": model.epoch_count}}
 
     def iteration_done(self, model, iteration, epoch, loss):
         if self.every_iter and iteration > 0 and \
                 iteration % self.every_iter == 0:
-            self.ckpt.save(iteration, self._state(model),
+            # orbax step label = the iteration the checkpoint was taken
+            # at; the stored counter = iteration + 1 (completed).
+            self.ckpt.save(iteration, self._state(model, iteration + 1),
                            metrics={"loss": float(loss)})
 
     def on_epoch_end(self, model, epoch):
-        if self.every_epoch and (epoch + 1) % self.every_epoch == 0:
-            self.ckpt.save(model.iteration_count, self._state(model))
+        if self.every_epoch and (epoch + 1) % self.every_epoch == 0 \
+                and model.iteration_count > 0:
+            # Same labeling contract as the iteration path: orbax step =
+            # last completed iteration index, stored counter = completed
+            # count (= step + 1).  Keeps the two paths from colliding on
+            # one step label with different counters.
+            self.ckpt.save(model.iteration_count - 1, self._state(model))
 
     def restore_into(self, model):
         """Resume a model in place from the newest checkpoint; returns the

@@ -29,15 +29,34 @@ from deeplearning4j_tpu.parallel.mesh import MeshConfig
 log = logging.getLogger("deeplearning4j_tpu")
 
 
-def _param_spec(path_leaf_shape, mesh, tp: int):
-    """Sharding rule for one parameter leaf under tensor parallelism.
+def _tp_shardable_layers(model) -> set:
+    """Layer/vertex names whose Dense 'W' kernels are safe to shard
+    column-wise (Megatron-style).  Recurrent fused-gate kernels ([in, 4h]
+    — gate slices would cross shard boundaries) and conv HWIO kernels are
+    EXCLUDED: they replicate, DP still shards their gradients' batch."""
+    from deeplearning4j_tpu.nn.conf.layers_core import DenseLayer
+    names = set()
+    if hasattr(model, "layers"):
+        items = ((f"layer_{i}", ly) for i, ly in enumerate(model.layers))
+    else:
+        items = ((n, s.layer) for n, s in model.conf.vertices.items()
+                 if s.layer is not None)
+    for name, ly in items:
+        if isinstance(ly, DenseLayer) and not getattr(ly, "IS_RNN", False):
+            names.add(name)
+    return names
 
-    Column-parallel heuristic (Megatron-style via GSPMD): 2-D+ kernels with
-    last dim divisible by tp shard the last dim on 'model'; everything else
-    replicates.  GSPMD propagates/contracts and inserts collectives."""
-    shape = path_leaf_shape
-    if tp > 1 and len(shape) >= 2 and shape[-1] % tp == 0:
-        return P(*([None] * (len(shape) - 1) + ["model"]))
+
+def _param_spec(path, shape, tp: int, shardable: set):
+    """Sharding rule for one parameter leaf under tensor parallelism.
+    `path` is a tree path whose second-to-last key is the owning
+    layer/vertex name (works for both the params tree and optimizer-state
+    trees that mirror it one level deeper)."""
+    keys = [getattr(p, "key", str(p)) for p in path]
+    layer = keys[-2] if len(keys) >= 2 else None
+    if (tp > 1 and len(shape) == 2 and keys and keys[-1] == "W"
+            and layer in shardable and shape[-1] % tp == 0):
+        return P(None, "model")
     return P()
 
 
@@ -57,19 +76,21 @@ class ShardedTrainer:
         self.solver = model._solver
 
         # Build sharding trees and place params/opt/model state.
-        self._param_shardings = jax.tree_util.tree_map(
-            lambda a: NamedSharding(
-                self.mesh, _param_spec(np.shape(a), self.mesh, self.tp)),
-            model.params_tree)
+        shardable = _tp_shardable_layers(model)
+
+        def sharding_tree(tree):
+            return jax.tree_util.tree_map_with_path(
+                lambda p, a: NamedSharding(
+                    self.mesh, _param_spec(p, np.shape(a), self.tp,
+                                           shardable)), tree)
+
+        self._param_shardings = sharding_tree(model.params_tree)
         self._replicated = NamedSharding(self.mesh, P())
         model.params_tree = jax.device_put(model.params_tree,
                                            self._param_shardings)
         if model.opt_state is None:
             model.opt_state = self.solver.init_opt_state(model.params_tree)
-        self._opt_shardings = jax.tree_util.tree_map(
-            lambda a: NamedSharding(
-                self.mesh, _param_spec(np.shape(a), self.mesh, self.tp)),
-            model.opt_state)
+        self._opt_shardings = sharding_tree(model.opt_state)
         model.opt_state = jax.device_put(model.opt_state, self._opt_shardings)
         model.state_tree = jax.device_put(
             model.state_tree,

@@ -1,0 +1,98 @@
+"""Multi-process distributed + preemption tests (VERDICT item 7).
+
+DL4J analogues: ``ModelParameterServerTest`` (multiple server instances
+over loopback Aeron) and Spark ``local[N]`` tests — here they are REAL
+separate OS processes joined by ``jax.distributed`` over loopback gRPC,
+and a real SIGKILL mid-training with orbax resume.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+WORKERS = os.path.join(os.path.dirname(__file__), "workers")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)  # workers force their own CPU platform
+    return env
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_distributed_dp(tmp_path):
+    """2 OS processes, 1 CPU device each, global mesh data=2: both ranks
+    must see process_count==2, train 5 steps, and report IDENTICAL
+    global-loss sequences (the all-reduce crosses the process boundary)."""
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(WORKERS, "dist_train_worker.py"),
+         str(rank), "2", str(port), str(tmp_path)],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for rank in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out.decode())
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert "WORKER_OK" in out
+    r0 = json.load(open(tmp_path / "rank0.json"))
+    r1 = json.load(open(tmp_path / "rank1.json"))
+    assert len(r0["losses"]) == 5
+    np.testing.assert_allclose(r0["losses"], r1["losses"], rtol=1e-6)
+    # and training made progress
+    assert r0["losses"][-1] < r0["losses"][0]
+
+
+@pytest.mark.slow
+def test_preemption_kill_and_resume(tmp_path):
+    """SIGKILL-style abrupt exit mid-training; resume from the orbax
+    checkpoint must reproduce the uninterrupted run's loss trajectory
+    exactly (dropout-free model, deterministic batch order)."""
+    ck1, ck2 = str(tmp_path / "ck_ref"), str(tmp_path / "ck_preempt")
+    ref_out = str(tmp_path / "ref.json")
+    res_out = str(tmp_path / "resumed.json")
+    run = lambda args: subprocess.run(
+        [sys.executable, os.path.join(WORKERS, "preempt_worker.py"), *args],
+        env=_env(), capture_output=True, timeout=300)
+
+    # uninterrupted reference: 10 steps
+    r = run([ck1, ref_out, "10"])
+    assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
+
+    # preempted run: dies abruptly (os._exit, no cleanup) after step >= 6
+    r = run([ck2, str(tmp_path / "x.json"), "10", "--kill-after", "6"])
+    assert r.returncode == 0
+    assert not (tmp_path / "x.json").exists()  # really died mid-run
+
+    # resume and finish
+    r = run([ck2, res_out, "10", "--resume"])
+    assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
+
+    ref = json.load(open(ref_out))
+    res = json.load(open(res_out))
+    assert res["final_iteration"] == 10
+    resumed_steps = sorted(int(k) for k in res["losses"])
+    # The abrupt exit may kill an in-flight async orbax save; resume must
+    # come from the last COMPLETE checkpoint (>= step 2), never step 0.
+    assert resumed_steps[0] >= 2
+    for k in res["losses"]:
+        np.testing.assert_allclose(res["losses"][k], ref["losses"][k],
+                                   rtol=1e-5, err_msg=f"step {k}")

@@ -109,10 +109,12 @@ def test_checkpoint_listener_resume(tmp_path, rng):
     fresh._build_solver()
     step = CheckpointListener(tmp_path / "auto").restore_into(fresh)
     assert step == 10
-    assert fresh.iteration_count == 10
-    assert np.allclose(np.asarray(fresh.output(x)),
-                       np.asarray(model.output(x)), atol=1e-5) is False \
-        or True  # model trained further; outputs equality not required
+    # restored counter = iterations completed = step + 1
+    assert fresh.iteration_count == 11
+    # The checkpoint was taken at step 10; `model` trained 2 further
+    # steps, so the restored snapshot must NOT equal the final model.
+    assert not np.allclose(np.asarray(fresh.output(x)),
+                           np.asarray(model.output(x)), atol=1e-6)
     # restored model must continue training without error
     fresh.fit(ds)
 
@@ -152,4 +154,7 @@ def test_trainer_with_checkpoint_listener_end_to_end(tmp_path, rng):
     restored = _model(seed=1)
     restored._build_solver()
     step = CheckpointListener(tmp_path / "dp").restore_into(restored)
-    assert step is not None and restored.iteration_count == step
+    # step label = iteration the checkpoint was taken at; the restored
+    # counter is iterations COMPLETED (step + 1), so resume continues
+    # with the next step instead of redoing the checkpointed one.
+    assert step is not None and restored.iteration_count == step + 1

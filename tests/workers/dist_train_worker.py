@@ -1,0 +1,74 @@
+"""2-process jax.distributed DP training worker (the loopback-Aeron
+``ModelParameterServerTest`` analogue — real gRPC control plane + real
+collectives between two OS processes on one host).
+
+Usage: python dist_train_worker.py <rank> <nproc> <port> <out_dir>
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+rank, nproc, port, out_dir = (int(sys.argv[1]), int(sys.argv[2]),
+                              int(sys.argv[3]), sys.argv[4])
+
+from deeplearning4j_tpu.parallel import distributed  # noqa: E402
+
+distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                       num_processes=nproc, process_id=rank)
+assert jax.process_count() == nproc, jax.process_count()
+assert len(jax.devices()) == nproc  # one CPU device per process
+
+mesh = distributed.global_mesh(data=nproc)
+
+from deeplearning4j_tpu import (MultiLayerNetwork,  # noqa: E402
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers_core import (  # noqa: E402
+    DenseLayer, OutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Sgd  # noqa: E402
+from deeplearning4j_tpu.optimize.solver import Solver  # noqa: E402
+
+conf = (NeuralNetConfiguration.builder().seed(11)
+        .updater(Sgd(learning_rate=0.1)).list()
+        .layer(DenseLayer(n_in=6, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .build())
+model = MultiLayerNetwork(conf).init()
+model._build_solver()
+
+# Global batch of 8: each process loads ITS OWN half (RDD-partition
+# analogue), jax assembles the global sharded array.
+rng = np.random.default_rng(0)
+gx = rng.normal(size=(8, 6)).astype(np.float32)
+gy = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+half = slice(rank * 4, rank * 4 + 4)
+
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+losses = []
+params, opt_state, mstate = (model.params_tree, None, model.state_tree)
+opt_state = model._solver.init_opt_state(params)
+rep = NamedSharding(mesh, P())
+params = jax.device_put(params, jax.tree_util.tree_map(lambda _: rep, params))
+opt_state = jax.device_put(opt_state,
+                           jax.tree_util.tree_map(lambda _: rep, opt_state))
+for step in range(5):
+    batch = {
+        "features": distributed.host_local_batch_to_global(mesh, gx[half]),
+        "labels": distributed.host_local_batch_to_global(mesh, gy[half]),
+    }
+    with mesh:
+        params, opt_state, mstate, loss = model._solver.step(
+            params, opt_state, mstate, step, batch, model._rng.next_key())
+    # loss is a replicated global scalar: identical on every process
+    losses.append(float(jax.device_get(loss)))
+
+with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+    json.dump({"rank": rank, "losses": losses}, f)
+print("WORKER_OK", rank, losses[-1])

@@ -1,0 +1,69 @@
+"""Preemption worker: trains with a CheckpointListener; the parent test
+SIGKILLs it mid-run, then relaunches with --resume, and finally compares
+against an uninterrupted reference run.
+
+Usage: python preempt_worker.py <ckpt_dir> <out_file> <n_steps>
+       [--resume] [--kill-after N]
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+ckpt_dir, out_file, n_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+resume = "--resume" in sys.argv
+kill_after = None
+if "--kill-after" in sys.argv:
+    kill_after = int(sys.argv[sys.argv.index("--kill-after") + 1])
+
+from deeplearning4j_tpu import (MultiLayerNetwork,  # noqa: E402
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers_core import (  # noqa: E402
+    DenseLayer, OutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam  # noqa: E402
+from deeplearning4j_tpu.parallel.checkpoint import (  # noqa: E402
+    CheckpointListener)
+
+conf = (NeuralNetConfiguration.builder().seed(5)
+        .updater(Adam(learning_rate=0.05)).list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .build())
+model = MultiLayerNetwork(conf).init()
+model._build_solver()
+ckpt = CheckpointListener(ckpt_dir, save_every_n_iterations=2, keep_last=2)
+model.set_listeners(ckpt)
+
+start = 0
+if resume:
+    restored = ckpt.restore_into(model)
+    assert restored is not None, "nothing to resume from"
+    start = model.iteration_count
+
+rng = np.random.default_rng(3)
+x = rng.normal(size=(64, 4)).astype(np.float32)
+y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+batches = [(x[i:i + 16], y[i:i + 16]) for i in range(0, 64, 16)]
+
+losses = {}
+step = start
+while step < n_steps:
+    bx, by = batches[step % len(batches)]
+    from deeplearning4j_tpu.data.dataset import DataSet
+    loss = model.fit(DataSet(bx, by))
+    losses[step] = loss
+    step = model.iteration_count
+    if kill_after is not None and step >= kill_after:
+        # Simulate abrupt preemption: no cleanup, no final save.
+        os._exit(0)
+
+with open(out_file, "w") as f:
+    json.dump({"losses": {str(k): v for k, v in losses.items()},
+               "final_iteration": model.iteration_count}, f)
+print("PREEMPT_WORKER_OK", model.iteration_count)
