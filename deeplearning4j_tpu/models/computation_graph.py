@@ -473,7 +473,7 @@ class ComputationGraph:
             params, state, inputs, training, rng, masks=fmasks,
             stop_before_output=True)
         loss = 0.0
-        for name in self.conf.network_outputs:
+        for head_i, name in enumerate(self.conf.network_outputs):
             out_layer = self.conf.vertices[name].layer
             if not isinstance(out_layer, BaseOutputLayerConf):
                 raise ValueError(
@@ -481,7 +481,11 @@ class ComputationGraph:
             z = out_layer.pre_output(params[name], head_inputs[name],
                                      self._compute_dtype)
             lmask = lmasks.get(name)
-            scores = out_layer.per_example_score(labels[name], z, lmask)
+            head_rng = (None if rng is None
+                        else jax.random.fold_in(rng, 0x5eed + head_i))
+            scores = out_layer.per_example_score(
+                labels[name], z, lmask, head_input=head_inputs[name],
+                rng=head_rng, params=params[name])
             if lmask is not None:
                 loss = loss + jnp.sum(scores) / jnp.maximum(jnp.sum(lmask), 1.0)
             else:

@@ -152,7 +152,12 @@ class MultiLayerNetwork:
         z = out_layer.pre_output(
             params[f"layer_{len(self.layers) - 1}"], h,
             self._compute_dtype)
-        scores = out_layer.per_example_score(labels, z, lmask)
+        # Distinct key for head sampling (e.g. VAE reparameterization):
+        # `rng` itself already parented the per-layer dropout splits.
+        head_rng = None if rng is None else jax.random.fold_in(rng, 0x5eed)
+        scores = out_layer.per_example_score(
+            labels, z, lmask, head_input=h, rng=head_rng,
+            params=params[f"layer_{len(self.layers) - 1}"])
         if lmask is not None:
             denom = jnp.maximum(jnp.sum(lmask), 1.0)
             loss = jnp.sum(scores) / denom

@@ -45,6 +45,13 @@ class InputType:
     def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
         return InputType("rnn", (timesteps, int(size)))
 
+    @staticmethod
+    def convolutional3d(depth: int, height: int, width: int,
+                        channels: int) -> "InputType":
+        """NDHWC volumetric input (DL4J InputType.convolutional3D)."""
+        return InputType("cnn3d", (int(depth), int(height), int(width),
+                                   int(channels)))
+
     def flat_size(self) -> int:
         n = 1
         for s in self.shape:
@@ -86,6 +93,8 @@ class Preprocessor:
             # DL4J CnnToRnn: [b,c,h,w] -> [b, c*h*w over time]? Actually maps
             # width as time: [b,h,w,c] -> [b, w, h*c]
             return x.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[2], -1)
+        if self.name == "cnn3d_to_ff":      # [b,d,h,w,c] -> [b, d*h*w*c]
+            return x.reshape(x.shape[0], -1)
         if self.name == "identity":
             return x
         raise ValueError(f"Unknown preprocessor {self.name!r}")
@@ -118,6 +127,9 @@ def adapt(input_type: InputType, wanted_kind: str):
     if kind == "cnn" and wanted_kind == "rnn":
         h, w, c = input_type.shape
         return Preprocessor("cnn_to_rnn"), InputType("rnn", (w, h * c))
+    if kind == "cnn3d" and wanted_kind == "ff":
+        return Preprocessor("cnn3d_to_ff"), InputType(
+            "ff", (input_type.flat_size(),))
     if kind == "rnn" and wanted_kind == "ff":
         t, f = input_type.shape
         # Dense over every timestep: fold time into batch (DL4J

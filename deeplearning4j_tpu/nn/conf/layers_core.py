@@ -163,7 +163,8 @@ class BaseOutputLayerConf(BaseLayerConf):
         gradient-check harness."""
         return z.astype(jnp.promote_types(z.dtype, jnp.float32))
 
-    def per_example_score(self, labels, z, mask=None):
+    def per_example_score(self, labels, z, mask=None, head_input=None,
+                          rng=None, params=None):
         """Per-example loss from PRE-activation z, fusing softmax/sigmoid
         into the loss when numerically profitable (LossMCXENT's fused path).
 
