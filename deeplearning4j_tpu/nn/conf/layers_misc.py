@@ -60,7 +60,9 @@ class PReLULayer(BaseLayerConf):
                     f"dim {i + 1} is dynamic — add it to shared_axes or "
                     "use a fixed InputType (e.g. recurrent(size, "
                     "timesteps))")
-        self.input_shape = tuple(int(d) for d in input_shape)
+        # Dynamic dims are legal only on shared axes (alpha dim 1 there).
+        self.input_shape = tuple(
+            int(d) if d is not None else None for d in input_shape)
         self._alpha_shape = tuple(int(d) for d in shape)
         return input_shape
 
@@ -90,7 +92,13 @@ class ElementWiseMultiplicationLayer(BaseLayerConf):
     WANTED_KINDS = ("ff",)
 
     def infer_shapes(self, input_shape):
-        self.n_in = self.n_out = int(input_shape[-1])
+        f = int(input_shape[-1])
+        if self.n_out is not None and self.n_out != f:
+            # DL4J validates nIn == nOut and fails fast.
+            raise ValueError(
+                f"ElementWiseMultiplicationLayer requires n_out == n_in "
+                f"(got n_out={self.n_out}, input width {f})")
+        self.n_in = self.n_out = f
         return input_shape
 
     def has_params(self):
@@ -165,8 +173,11 @@ class LocallyConnected2D(BaseLayerConf):
         patches = lax.conv_general_dilated_patches(
             x, _pair(self.kernel_size), _pair(self.stride), self._padding(),
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        # patches feature dim is C*kh*kw (channel-major); W was built to
-        # match that layout (see _patch_perm note in LocallyConnected1D).
+        # conv_general_dilated_patches emits the patch feature dim as
+        # C*kh*kw with the INPUT CHANNEL major (spatial offsets minor);
+        # W's [oh, ow, kh*kw*cin, cout] dim 2 uses the same order.  Any
+        # future weight importer for locally-connected layers must
+        # permute into this layout.
         y = jnp.einsum("bhwk,hwko->bhwo", patches, w)
         if self.has_bias:
             y = y + params["b"].astype(y.dtype)
@@ -601,8 +612,10 @@ class VariationalAutoencoder(BaseOutputLayerConf):
                     r_logvar + jnp.square(target - r_mu) / jnp.exp(r_logvar)
                     + jnp.log(2 * jnp.pi), axis=-1)
             if self.reconstruction_distribution == "bernoulli":
-                return jnp.sum(
-                    out * (1 - target) + jnp.log1p(jnp.exp(-out)), axis=-1)
+                # softplus form: stable for large |logit| (exp(-out)
+                # overflows f32 past ~88)
+                return jnp.sum(jax.nn.softplus(out) - out * target,
+                               axis=-1)
             raise ValueError(self.reconstruction_distribution)
 
         nll = jnp.mean(jax.vmap(recon_nll)(eps), axis=0)

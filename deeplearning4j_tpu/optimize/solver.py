@@ -21,6 +21,34 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.optimize.updaters import BaseUpdater
 
 
+def check_numerics_enabled() -> bool:
+    """NaN/Inf debug mode (``OpProfiler`` ``checkForNAN``/``checkForINF``
+    analogue): ``DL4J_TPU_CHECK_NUMERICS=1`` makes every train step
+    validate its loss and updated params host-side, naming the offending
+    leaves.  Costs one device sync per step — a debug mode, as upstream."""
+    import os
+    return os.environ.get("DL4J_TPU_CHECK_NUMERICS", "") in ("1", "true")
+
+
+def check_numerics(loss, params, step_idx: int):
+    import numpy as np
+    l = np.asarray(jax.device_get(loss))
+    bad = []
+    if not np.isfinite(l).all():
+        bad.append(f"loss={float(l)}")
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            jax.device_get(params)):
+        a = np.asarray(leaf)
+        if not np.isfinite(a).all():
+            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            n_bad = int((~np.isfinite(a)).sum())
+            bad.append(f"params[{name}]: {n_bad}/{a.size} non-finite")
+    if bad:
+        raise FloatingPointError(
+            f"Non-finite values after train step {step_idx} "
+            f"(DL4J_TPU_CHECK_NUMERICS): " + "; ".join(bad[:8]))
+
+
 def normalize_gradients(grads, kind: Optional[str], threshold: float):
     """DL4J ``GradientNormalization`` semantics
     (``org.deeplearning4j.nn.conf.GradientNormalization``)."""
@@ -115,5 +143,8 @@ class Solver:
     def step(self, params, opt_state, model_state, step_idx, batch, rng):
         """One optimization iteration; returns (params, opt_state,
         model_state, loss).  Donated inputs must not be reused by caller."""
-        return self._step(params, opt_state, model_state,
-                          jnp.asarray(step_idx, jnp.int32), batch, rng)
+        out = self._step(params, opt_state, model_state,
+                         jnp.asarray(step_idx, jnp.int32), batch, rng)
+        if check_numerics_enabled():
+            check_numerics(out[3], out[0], int(step_idx))
+        return out

@@ -1,0 +1,20 @@
+"""Training observability: stats stream, storage, static report, NaN
+debug mode, profiler hook.
+
+Reference surfaces replaced (SURVEY §5.1/§5.5):
+* ``StatsListener`` → ``StatsStorage`` → Vert.x web UI
+  (``deeplearning4j-ui-parent``): here a structured per-iteration stats
+  stream into in-memory/jsonl storage plus a dependency-free static HTML
+  report (no server — this framework targets headless TPU jobs).
+* ``OpProfiler`` ``checkForNAN/INF`` debug modes → ``check_numerics``
+  (host-side scan of loss/grads/params with named-leaf errors).
+* profiling → ``ProfilerListener`` driving ``jax.profiler`` traces
+  (XProf/TensorBoard-compatible).
+"""
+from deeplearning4j_tpu.ui.stats import (
+    FileStatsStorage, InMemoryStatsStorage, ProfilerListener, StatsListener,
+    StatsStorage)
+from deeplearning4j_tpu.ui.report import render_report
+
+__all__ = ["StatsListener", "StatsStorage", "InMemoryStatsStorage",
+           "FileStatsStorage", "ProfilerListener", "render_report"]

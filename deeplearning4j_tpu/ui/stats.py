@@ -1,0 +1,152 @@
+"""StatsListener / StatsStorage / ProfilerListener.
+
+Parity: ``org.deeplearning4j.ui.stats.StatsListener`` persisting into
+``StatsStorage`` (``InMemoryStatsStorage`` / ``FileStatsStorage``).  The
+record schema is one flat JSON object per iteration — loss, timing,
+throughput, and (optionally) per-layer parameter/update summaries
+(mean/std/absmax — the histograms DL4J's UI charts, reduced to the
+moments that matter).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+class StatsStorage:
+    """Append-only store of per-iteration records."""
+
+    def put(self, record: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def records(self) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """``InMemoryStatsStorage``."""
+
+    def __init__(self):
+        self._records: List[Dict[str, Any]] = []
+
+    def put(self, record):
+        self._records.append(record)
+
+    def records(self):
+        return list(self._records)
+
+
+class FileStatsStorage(StatsStorage):
+    """``FileStatsStorage`` — one JSON object per line (jsonl), readable
+    while training runs (tail -f replaces the web UI's live stream)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def put(self, record):
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    def records(self):
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+
+def _leaf_summary(arr) -> Dict[str, float]:
+    a = np.asarray(arr, np.float32)
+    return {"mean": float(a.mean()), "std": float(a.std()),
+            "absmax": float(np.abs(a).max())}
+
+
+class StatsListener(TrainingListener):
+    """Streams one structured record per iteration into a StatsStorage.
+
+    ``collect_param_stats`` adds per-layer parameter summaries to every
+    ``param_stats_frequency``-th EMITTED record (so it composes with any
+    ``frequency`` value; device->host transfer of the whole param tree —
+    keep it sparse in production, exactly the guidance DL4J's docs gave
+    for StatsListener histograms)."""
+
+    def __init__(self, storage: StatsStorage, frequency: int = 1,
+                 collect_param_stats: bool = False,
+                 param_stats_frequency: int = 50):
+        self.storage = storage
+        self.frequency = max(1, int(frequency))
+        self.collect_param_stats = collect_param_stats
+        self.param_stats_frequency = max(1, int(param_stats_frequency))
+        self._last_t: Optional[float] = None
+        self._emitted = 0
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency:
+            self._last_t = time.perf_counter()
+            return
+        now = time.perf_counter()
+        rec: Dict[str, Any] = {
+            "iteration": iteration,
+            "epoch": epoch,
+            "loss": float(score),
+            "timestamp": time.time(),
+            "batch_size": int(getattr(model, "last_batch_size", 0) or 0),
+        }
+        if self._last_t is not None:
+            dt = now - self._last_t
+            rec["iter_seconds"] = round(dt, 6)
+            if rec["batch_size"] and dt > 0:
+                rec["examples_per_sec"] = round(rec["batch_size"] / dt, 2)
+        self._last_t = now
+        if (self.collect_param_stats
+                and self._emitted % self.param_stats_frequency == 0):
+            import jax
+            params = jax.device_get(model.params_tree)
+            rec["params"] = {
+                "/".join(str(getattr(k, "key", k)) for k in path):
+                    _leaf_summary(leaf)
+                for path, leaf in
+                jax.tree_util.tree_leaves_with_path(params)}
+        self._emitted += 1
+        self.storage.put(rec)
+
+
+class ProfilerListener(TrainingListener):
+    """Captures a ``jax.profiler`` trace for iterations
+    [start_iteration, start_iteration + n_iterations) — the XProf/
+    TensorBoard trace that replaces ``OpProfiler`` wall-time tables."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 10,
+                 n_iterations: int = 5):
+        self.log_dir = str(log_dir)
+        self.start = int(start_iteration)
+        self.n = int(n_iterations)
+        self._active = False
+        self.trace_dir: Optional[str] = None
+
+    def iteration_done(self, model, iteration, epoch, score):
+        import jax
+        if iteration == self.start and not self._active:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and iteration >= self.start + self.n:
+            jax.block_until_ready(model.params_tree)
+            jax.profiler.stop_trace()
+            self._active = False
+            self.trace_dir = self.log_dir
+
+    def on_epoch_end(self, model, epoch):
+        if self._active:  # training ended mid-window
+            import jax
+            jax.block_until_ready(model.params_tree)
+            jax.profiler.stop_trace()
+            self._active = False
+            self.trace_dir = self.log_dir
