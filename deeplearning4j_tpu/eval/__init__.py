@@ -6,9 +6,10 @@ Accumulation is streaming (call ``eval`` per batch) like DL4J, so large
 test sets never materialize at once.
 """
 
+from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
 from deeplearning4j_tpu.eval.classification import Evaluation, EvaluationBinary
 from deeplearning4j_tpu.eval.regression import RegressionEvaluation
 from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
 
-__all__ = ["Evaluation", "EvaluationBinary", "RegressionEvaluation", "ROC",
-           "ROCMultiClass"]
+__all__ = ["Evaluation", "EvaluationBinary", "EvaluationCalibration",
+           "RegressionEvaluation", "ROC", "ROCMultiClass"]

@@ -1,0 +1,95 @@
+"""EvaluationCalibration (``org.nd4j.evaluation.classification
+.EvaluationCalibration``): reliability diagram bins, expected calibration
+error, probability/residual histograms.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class EvaluationCalibration:
+    """Accumulates (predicted probability, one-hot label) batches.
+
+    ``reliability_bins`` returns, per confidence bin, the mean predicted
+    probability and observed accuracy of the PREDICTED class — the
+    reliability-diagram data; ``expected_calibration_error`` is the
+    bin-weighted |accuracy − confidence|.
+    """
+
+    def __init__(self, n_bins: int = 10, histogram_bins: int = 20):
+        self.n_bins = int(n_bins)
+        self.histogram_bins = int(histogram_bins)
+        self._conf: List[np.ndarray] = []
+        self._correct: List[np.ndarray] = []
+        self._probs: List[np.ndarray] = []
+        self._labels: List[np.ndarray] = []
+
+    def eval(self, labels, predictions):
+        """labels one-hot [b, C] (or int [b]); predictions probs [b, C]."""
+        p = np.asarray(predictions, np.float64)
+        lab = np.asarray(labels)
+        y = lab.argmax(-1) if lab.ndim == p.ndim else lab.astype(np.int64)
+        pred = p.argmax(-1)
+        self._conf.append(p.max(-1))
+        self._correct.append((pred == y).astype(np.float64))
+        self._probs.append(p)
+        self._labels.append(np.eye(p.shape[-1])[y])
+
+    # ------------------------------------------------------------------
+    def _cat(self):
+        if not self._conf:
+            raise ValueError("eval(...) some batches first")
+        return (np.concatenate(self._conf), np.concatenate(self._correct))
+
+    def reliability_bins(self):
+        conf, correct = self._cat()
+        edges = np.linspace(0.0, 1.0, self.n_bins + 1)
+        rows = []
+        for i in range(self.n_bins):
+            lo, hi = edges[i], edges[i + 1]
+            m = (conf >= lo) & (conf < hi if i < self.n_bins - 1
+                                else conf <= hi)
+            n = int(m.sum())
+            rows.append({
+                "bin": (float(lo), float(hi)),
+                "count": n,
+                "mean_confidence": float(conf[m].mean()) if n else None,
+                "accuracy": float(correct[m].mean()) if n else None,
+            })
+        return rows
+
+    def expected_calibration_error(self) -> float:
+        conf, correct = self._cat()
+        n = conf.size
+        ece = 0.0
+        for row in self.reliability_bins():
+            if row["count"]:
+                ece += (row["count"] / n) * abs(
+                    row["accuracy"] - row["mean_confidence"])
+        return float(ece)
+
+    def probability_histogram(self, class_idx: Optional[int] = None):
+        """Histogram of predicted probabilities (all classes, or one)."""
+        self._cat()  # uniform "eval(...) some batches first" guard
+        p = np.concatenate(self._probs)
+        vals = p.reshape(-1) if class_idx is None else p[:, class_idx]
+        counts, edges = np.histogram(vals, bins=self.histogram_bins,
+                                     range=(0.0, 1.0))
+        return counts.tolist(), edges.tolist()
+
+    def residual_histogram(self):
+        """Histogram of |label − prob| residuals (DL4J residual plot)."""
+        self._cat()
+        p = np.concatenate(self._probs)
+        lab = np.concatenate(self._labels)
+        res = np.abs(lab - p).reshape(-1)
+        counts, edges = np.histogram(res, bins=self.histogram_bins,
+                                     range=(0.0, 1.0))
+        return counts.tolist(), edges.tolist()
+
+    def stats(self) -> str:
+        ece = self.expected_calibration_error()
+        return (f"EvaluationCalibration: n={self._cat()[0].size} "
+                f"bins={self.n_bins} ECE={ece:.4f}")

@@ -20,3 +20,4 @@ __all__ = [
     "NeuralNetConfiguration",
     "MultiLayerConfiguration",
 ]
+from deeplearning4j_tpu.nn.conf import layers_objdetect  # noqa: F401  (registry)

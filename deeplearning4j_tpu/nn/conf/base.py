@@ -33,10 +33,23 @@ def register_layer(cls):
     return cls
 
 
+def _populate_registry():
+    """Import every layer-conf module so @register_layer runs — needed
+    when a process deserializes a checkpoint without having imported the
+    package surface (e.g. only utils.model_serializer)."""
+    import importlib
+    for mod in ("layers_core", "layers_conv", "layers_recurrent",
+                "layers_misc", "layers_objdetect"):
+        importlib.import_module(f"deeplearning4j_tpu.nn.conf.{mod}")
+
+
 def layer_from_dict(d: Dict[str, Any]) -> "BaseLayerConf":
     d = dict(d)
     type_name = d.pop("type")
     cls = _LAYER_REGISTRY.get(type_name)
+    if cls is None:
+        _populate_registry()
+        cls = _LAYER_REGISTRY.get(type_name)
     if cls is None:
         raise ValueError(f"Unknown layer type {type_name!r} in config")
     field_names = {f.name for f in dataclasses.fields(cls)}

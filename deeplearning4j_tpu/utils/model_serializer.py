@@ -143,3 +143,15 @@ def restore_computation_graph(path, load_updater: bool = True):
             model.iteration_count = t.get("iteration_count", 0)
             model.epoch_count = t.get("epoch_count", 0)
     return model
+
+
+def restore_model(path, load_updater: bool = True):
+    """Restore either model class by inspecting the stored config (a
+    ComputationGraph config has a "vertices" table; a MultiLayerNetwork
+    config has a "layers" list) — no blind try/except that would mask
+    real restore errors."""
+    with zipfile.ZipFile(path) as zf:
+        conf = json.loads(zf.read(_CONFIG))
+    if "vertices" in conf:
+        return restore_computation_graph(path, load_updater)
+    return restore_multi_layer_network(path, load_updater)

@@ -37,9 +37,5 @@ class ZooModel:
 
     # DL4J initPretrained(PretrainedType) — local checkpoint stand-in
     def init_pretrained(self, checkpoint_path: str):
-        from deeplearning4j_tpu.utils.model_serializer import (
-            restore_computation_graph, restore_multi_layer_network)
-        try:
-            return restore_computation_graph(checkpoint_path)
-        except Exception:
-            return restore_multi_layer_network(checkpoint_path)
+        from deeplearning4j_tpu.utils.model_serializer import restore_model
+        return restore_model(checkpoint_path)

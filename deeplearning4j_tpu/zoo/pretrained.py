@@ -1,0 +1,90 @@
+"""Pretrained-weight registry (``org.deeplearning4j.zoo.ZooModel``
+``initPretrained(PretrainedType)`` + its URL/checksum table).
+
+No egress in this environment, so the registry maps (model, dataset) →
+LOCAL checkpoint path + sha256 — the same integrity contract as
+upstream's ``checkSumForPretrained``/``pretrainedUrl`` pair, with the
+cache directory taken from ``DL4J_TPU_PRETRAINED_DIR``.  Publishing a
+weight set = ``save_pretrained`` (writes the zip + prints its checksum)
++ one ``register`` line.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+_REGISTRY: Dict[Tuple[str, str], Dict[str, str]] = {}
+
+
+def cache_dir() -> str:
+    return os.environ.get("DL4J_TPU_PRETRAINED_DIR",
+                          os.path.expanduser("~/.deeplearning4j_tpu"))
+
+
+def sha256_of(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def register(model_name: str, dataset: str, path: str, sha256: str):
+    _REGISTRY[(model_name, dataset)] = {"path": path, "sha256": sha256}
+
+
+def registered() -> Dict[Tuple[str, str], Dict[str, str]]:
+    return dict(_REGISTRY)
+
+
+def save_pretrained(model, model_name: str, dataset: str,
+                    directory: Optional[str] = None) -> Dict[str, str]:
+    """Serialize a trained model as a registered pretrained weight set;
+    returns the registry entry (path + sha256)."""
+    from deeplearning4j_tpu.utils.model_serializer import write_model
+    d = directory or cache_dir()
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{model_name}_{dataset}.zip")
+    write_model(model, path)
+    digest = sha256_of(path)
+    register(model_name, dataset, path, digest)
+    # sidecar manifest so a fresh process can re-register without code
+    with open(path + ".json", "w") as f:
+        json.dump({"model": model_name, "dataset": dataset,
+                   "path": path, "sha256": digest}, f)
+    return _REGISTRY[(model_name, dataset)]
+
+
+def load_pretrained(model_name: str, dataset: str,
+                    directory: Optional[str] = None):
+    """Restore a registered weight set, verifying the checksum first
+    (corrupted/tampered files are rejected, as upstream).  A fresh
+    process rediscovers entries from the sidecar manifest in
+    ``directory`` (default: the cache dir — pass the same directory you
+    gave ``save_pretrained``)."""
+    entry = _REGISTRY.get((model_name, dataset))
+    if entry is None:
+        manifest = os.path.join(directory or cache_dir(),
+                                f"{model_name}_{dataset}.zip.json")
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                m = json.load(f)
+            entry = {"path": m.get("path",
+                                   manifest[: -len(".json")]),
+                     "sha256": m["sha256"]}
+            _REGISTRY[(model_name, dataset)] = entry
+        else:
+            raise KeyError(
+                f"No pretrained weights registered for "
+                f"({model_name!r}, {dataset!r}); have "
+                f"{sorted(_REGISTRY)}")
+    actual = sha256_of(entry["path"])
+    if actual != entry["sha256"]:
+        raise IOError(
+            f"Checksum mismatch for {entry['path']}: expected "
+            f"{entry['sha256'][:12]}…, got {actual[:12]}… — refusing to "
+            "load corrupted weights")
+    from deeplearning4j_tpu.utils.model_serializer import restore_model
+    return restore_model(entry["path"])

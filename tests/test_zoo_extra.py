@@ -1,0 +1,165 @@
+"""Round-2 zoo additions: UNet, InceptionResNetV1, Darknet19, TinyYOLO,
+pretrained-weight registry, EvaluationCalibration."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.eval import EvaluationCalibration
+from deeplearning4j_tpu.zoo import (Darknet19, InceptionResNetV1, TinyYOLO,
+                                    UNet, load_pretrained, save_pretrained)
+
+rng = np.random.default_rng(3)
+
+
+def test_unet_trains_per_pixel():
+    model = UNet(n_classes=2, depth=2, base_filters=4,
+                 input_shape=(16, 16, 1)).init_graph()
+    x = rng.normal(size=(4, 16, 16, 1)).astype(np.float32)
+    # segment = "pixel > 0"
+    y = np.stack([(x[..., 0] <= 0), (x[..., 0] > 0)], -1).astype(np.float32)
+    losses = [model.fit(DataSet(x, y)) for _ in range(15)]
+    assert losses[-1] < losses[0]
+    out = model.output(x)
+    out = np.asarray(out["output"] if isinstance(out, dict) else out)
+    assert out.shape == (4, 16, 16, 2)
+    # per-pixel softmax
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-4)
+
+
+def test_inception_resnet_builds_and_steps():
+    model = InceptionResNetV1(n_classes=5, blocks=2, filters=8,
+                              input_shape=(32, 32, 3)).init_graph()
+    x = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 2)]
+    loss = model.fit(DataSet(x, y))
+    assert np.isfinite(loss)
+    # JSON round-trip like every zoo model
+    from deeplearning4j_tpu.models.computation_graph import (
+        ComputationGraph, ComputationGraphConfiguration)
+    conf2 = ComputationGraphConfiguration.from_json(model.conf.to_json())
+    assert ComputationGraph(conf2).init()
+
+
+def test_darknet19_classifier():
+    model = Darknet19(n_classes=4, width=8,
+                      input_shape=(32, 32, 3)).init_graph()
+    x = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)]
+    assert np.isfinite(model.fit(DataSet(x, y)))
+
+
+def test_tiny_yolo_detection_loss_decreases():
+    model = TinyYOLO(n_classes=3, width=8,
+                     input_shape=(32, 32, 3)).init_graph()
+    x = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+    # grid 4x4 (32 / 2^3); one object per image at a random cell
+    labels = np.zeros((4, 4, 4, 5 + 3), np.float32)
+    for b in range(4):
+        gi, gj = rng.integers(0, 4, 2)
+        labels[b, gi, gj, 0] = 1.0                      # objectness
+        labels[b, gi, gj, 1:3] = rng.random(2)          # cx, cy
+        labels[b, gi, gj, 3:5] = rng.random(2) + 0.5    # w, h
+        labels[b, gi, gj, 5 + rng.integers(0, 3)] = 1.0
+    losses = [model.fit(DataSet(x, labels)) for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+    out = model.output(x)
+    out = np.asarray(out["output"] if isinstance(out, dict) else out)
+    assert out.shape == (4, 4, 4, 8)
+    # activations applied: objectness/xy in [0,1], classes sum to 1
+    assert (out[..., 0] >= 0).all() and (out[..., 0] <= 1).all()
+    np.testing.assert_allclose(out[..., 5:].sum(-1), 1.0, atol=1e-4)
+
+
+def test_yolo_channel_validation():
+    from deeplearning4j_tpu.zoo import Yolo2OutputLayer
+    with pytest.raises(ValueError, match="channels"):
+        Yolo2OutputLayer(n_classes=7).infer_shapes((4, 4, 8))
+
+
+def test_pretrained_registry_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_PRETRAINED_DIR", str(tmp_path))
+    model = Darknet19(n_classes=4, width=8,
+                      input_shape=(32, 32, 3)).init_graph()
+    x = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 2)]
+    model.fit(DataSet(x, y))
+    entry = save_pretrained(model, "darknet19", "toy")
+    assert len(entry["sha256"]) == 64
+
+    restored = load_pretrained("darknet19", "toy")
+    a = model.output(x)
+    b = restored.output(x)
+    a = np.asarray(a["output"] if isinstance(a, dict) else a)
+    b = np.asarray(b["output"] if isinstance(b, dict) else b)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    # corruption is rejected by checksum
+    with open(entry["path"], "r+b") as f:
+        f.seek(100)
+        f.write(b"\x00\x01\x02")
+    with pytest.raises(IOError, match="Checksum"):
+        load_pretrained("darknet19", "toy")
+
+
+def test_evaluation_calibration():
+    ev = EvaluationCalibration(n_bins=5)
+    # perfectly calibrated synthetic: P(correct) == confidence
+    r = np.random.default_rng(0)
+    n = 20000
+    conf = r.uniform(0.5, 1.0, n)
+    correct = r.random(n) < conf
+    probs = np.where(correct[:, None],
+                     np.stack([conf, 1 - conf], -1),
+                     np.stack([1 - conf, conf], -1))
+    # label = class 0 always; prediction correct iff argmax==0
+    labels = np.zeros((n, 2))
+    labels[:, 0] = 1
+    ev.eval(labels, probs)
+    ece = ev.expected_calibration_error()
+    assert ece < 0.02, ece
+    bins = ev.reliability_bins()
+    assert len(bins) == 5
+    hi = bins[-1]
+    assert hi["count"] > 0 and abs(hi["accuracy"] - hi["mean_confidence"]) < 0.05
+    counts, edges = ev.residual_histogram()
+    assert sum(counts) == n * 2 and len(edges) == 21
+    assert "ECE" in ev.stats()
+
+
+def test_evaluation_calibration_detects_overconfidence():
+    ev = EvaluationCalibration(n_bins=5)
+    r = np.random.default_rng(1)
+    n = 5000
+    # always 95% confident but only 60% accurate
+    correct = r.random(n) < 0.6
+    probs = np.where(correct[:, None], [[0.95, 0.05]], [[0.05, 0.95]])
+    labels = np.zeros((n, 2))
+    labels[:, 0] = 1
+    ev.eval(labels, probs)
+    assert ev.expected_calibration_error() > 0.3
+
+
+def test_yolo_checkpoint_restores_without_zoo_import(tmp_path):
+    """Regression: Yolo2OutputLayer lives in nn/conf so restore works in
+    a process that never imports the zoo package."""
+    import subprocess
+    import sys
+    model = TinyYOLO(n_classes=2, width=4,
+                     input_shape=(16, 16, 1)).init_graph()
+    from deeplearning4j_tpu.utils.model_serializer import write_model
+    p = str(tmp_path / "yolo.zip")
+    write_model(model, p)
+    code = (
+        "import os; os.environ['XLA_FLAGS']=''\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from deeplearning4j_tpu.utils.model_serializer import restore_model\n"
+        f"m = restore_model({p!r})\n"
+        "print('RESTORED', type(m).__name__)\n")
+    import os
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, timeout=180)
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    assert b"RESTORED ComputationGraph" in r.stdout
