@@ -1,0 +1,20 @@
+"""NLP: Word2Vec / ParagraphVectors, tokenizers, vector serialization.
+
+Reference: ``deeplearning4j-nlp-parent`` —
+``org.deeplearning4j.models.word2vec.Word2Vec`` (skip-gram, hierarchical
+softmax + negative sampling, custom threaded trainer),
+``models.paragraphvectors.ParagraphVectors``,
+``text.tokenization.tokenizerfactory.*``, ``WordVectorSerializer``.
+
+TPU-first: instead of the reference's lock-free multithreaded HS trees,
+training is BATCHED skip-gram with negative sampling — pair generation
+on host, one jitted embedding-update step on device (the formulation
+that keeps the MXU busy and needs no parameter locking at all).
+"""
+from deeplearning4j_tpu.nlp.tokenizer import (DefaultTokenizerFactory,
+                                              RegexTokenizerFactory)
+from deeplearning4j_tpu.nlp.word2vec import ParagraphVectors, Word2Vec
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+__all__ = ["Word2Vec", "ParagraphVectors", "DefaultTokenizerFactory",
+           "RegexTokenizerFactory", "WordVectorSerializer"]

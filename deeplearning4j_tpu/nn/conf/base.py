@@ -34,13 +34,19 @@ def register_layer(cls):
 
 
 def _populate_registry():
-    """Import every layer-conf module so @register_layer runs — needed
-    when a process deserializes a checkpoint without having imported the
-    package surface (e.g. only utils.model_serializer)."""
+    """Import every layers_* module in this package so @register_layer
+    runs — needed when a process deserializes a checkpoint without
+    having imported the package surface (e.g. only
+    utils.model_serializer).  Discovered, not hardcoded, so new layer
+    modules are covered automatically."""
     import importlib
-    for mod in ("layers_core", "layers_conv", "layers_recurrent",
-                "layers_misc", "layers_objdetect"):
-        importlib.import_module(f"deeplearning4j_tpu.nn.conf.{mod}")
+    import pkgutil
+
+    import deeplearning4j_tpu.nn.conf as conf_pkg
+    for info in pkgutil.iter_modules(conf_pkg.__path__):
+        if info.name.startswith("layers"):
+            importlib.import_module(
+                f"deeplearning4j_tpu.nn.conf.{info.name}")
 
 
 def layer_from_dict(d: Dict[str, Any]) -> "BaseLayerConf":

@@ -1,0 +1,15 @@
+"""Reinforcement learning (RL4J: ``rl4j-core
+org.deeplearning4j.rl4j.**``): MDP protocol, replay buffer, deep
+Q-learning with a target network, epsilon-greedy policy.
+
+TPU-first: the Q-network is a framework MultiLayerNetwork whose TD
+update is the same single jitted train step as supervised fit — replay
+sampling and environment stepping stay host-side (they're control flow,
+not FLOPs).
+"""
+from deeplearning4j_tpu.rl.mdp import MDP, SimpleGridWorld
+from deeplearning4j_tpu.rl.dqn import (DQNPolicy, QLearningConfiguration,
+                                       QLearningDiscrete, ReplayBuffer)
+
+__all__ = ["MDP", "SimpleGridWorld", "QLearningDiscrete",
+           "QLearningConfiguration", "ReplayBuffer", "DQNPolicy"]

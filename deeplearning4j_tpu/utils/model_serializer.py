@@ -146,12 +146,17 @@ def restore_computation_graph(path, load_updater: bool = True):
 
 
 def restore_model(path, load_updater: bool = True):
-    """Restore either model class by inspecting the stored config (a
-    ComputationGraph config has a "vertices" table; a MultiLayerNetwork
-    config has a "layers" list) — no blind try/except that would mask
-    real restore errors."""
+    """Restore either model class using the config's "format"
+    discriminator (structural sniff as legacy fallback) — no blind
+    try/except that would mask real restore errors."""
     with zipfile.ZipFile(path) as zf:
         conf = json.loads(zf.read(_CONFIG))
+    fmt = conf.get("format", "")
+    if "ComputationGraphConfiguration" in fmt:
+        return restore_computation_graph(path, load_updater)
+    if "MultiLayerConfiguration" in fmt:
+        return restore_multi_layer_network(path, load_updater)
+    # legacy/foreign writers: fall back to the structural sniff
     if "vertices" in conf:
         return restore_computation_graph(path, load_updater)
     return restore_multi_layer_network(path, load_updater)

@@ -53,7 +53,7 @@ def save_pretrained(model, model_name: str, dataset: str,
     # sidecar manifest so a fresh process can re-register without code
     with open(path + ".json", "w") as f:
         json.dump({"model": model_name, "dataset": dataset,
-                   "path": path, "sha256": digest}, f)
+                   "sha256": digest}, f)
     return _REGISTRY[(model_name, dataset)]
 
 
@@ -71,8 +71,11 @@ def load_pretrained(model_name: str, dataset: str,
         if os.path.exists(manifest):
             with open(manifest) as f:
                 m = json.load(f)
-            entry = {"path": m.get("path",
-                                   manifest[: -len(".json")]),
+            # the zip sits NEXT TO its manifest: derive the path from
+            # the manifest location so a published/copied weight
+            # directory keeps working (the recorded absolute path went
+            # stale the moment the directory moved)
+            entry = {"path": manifest[: -len(".json")],
                      "sha256": m["sha256"]}
             _REGISTRY[(model_name, dataset)] = entry
         else:

@@ -1,0 +1,165 @@
+"""Periphery subsystems: NLP (Word2Vec/ParagraphVectors/serializer),
+RL (DQN on a gridworld), Arbiter (hyperparameter search).
+
+DL4J analogues: word2vec convergence/nearest-words tests in
+deeplearning4j-nlp, rl4j QLearningDiscrete gym tests, arbiter
+random/grid search tests.
+"""
+import numpy as np
+import pytest
+
+
+# ------------------------------------------------------------------ NLP
+def _topic_corpus(n=300, seed=0):
+    """Two topics with disjoint vocab; sentences stay within a topic, so
+    within-topic words co-occur and must embed closer than across."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "bird", "fish"]
+    tech = ["cpu", "gpu", "code", "data", "chip"]
+    out = []
+    for _ in range(n):
+        words = animals if rng.random() < 0.5 else tech
+        out.append(" ".join(rng.choice(words, 6)))
+    return out
+
+
+def test_word2vec_learns_topics():
+    from deeplearning4j_tpu.nlp import Word2Vec
+    w2v = Word2Vec(vector_size=16, window_size=3, negative=4, epochs=20,
+                   learning_rate=1.0, seed=1)
+    losses = w2v.fit(_topic_corpus())
+    assert losses[-1] < losses[0]
+    assert w2v.has_word("cat") and len(w2v.vocab) == 10
+    within = w2v.similarity("cat", "dog")
+    across = w2v.similarity("cat", "gpu")
+    assert within > across + 0.2, (within, across)
+    near = w2v.words_nearest("cpu", 4)
+    assert set(near) <= {"gpu", "code", "data", "chip"}, near
+
+
+def test_word2vec_serializer_roundtrip(tmp_path):
+    from deeplearning4j_tpu.nlp import Word2Vec, WordVectorSerializer
+    w2v = Word2Vec(vector_size=8, epochs=2, seed=2)
+    w2v.fit(_topic_corpus(50))
+    p = str(tmp_path / "vecs.txt")
+    WordVectorSerializer.write_word_vectors(w2v, p)
+    loaded = WordVectorSerializer.read_word_vectors(p)
+    assert loaded.index2word == w2v.index2word
+    np.testing.assert_allclose(loaded.get_word_vector("cat"),
+                               w2v.get_word_vector("cat"), atol=1e-5)
+
+
+def test_paragraph_vectors_separate_topics():
+    from deeplearning4j_tpu.nlp import ParagraphVectors
+    docs = _topic_corpus(60, seed=3)
+    pv = ParagraphVectors(vector_size=12, negative=4, epochs=20,
+                          learning_rate=1.0, seed=3)
+    pv.fit(docs)
+    animal = {"cat", "dog", "horse", "bird", "fish"}
+    is_animal = [docs[i].split()[0] in animal for i in range(len(docs))]
+    vecs = np.stack([pv.get_doc_vector(i) for i in range(len(docs))])
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True) + 1e-9
+    a = vecs[np.asarray(is_animal)]
+    t = vecs[~np.asarray(is_animal)]
+    within = (a @ a.mean(0)).mean() + (t @ t.mean(0)).mean()
+    across = (a @ t.mean(0)).mean() + (t @ a.mean(0)).mean()
+    assert within > across, (within, across)
+
+
+def test_tokenizers():
+    from deeplearning4j_tpu.nlp import (DefaultTokenizerFactory,
+                                        RegexTokenizerFactory)
+    assert DefaultTokenizerFactory().tokenize("Hello, World!") == \
+        ["hello", "world"]
+    assert RegexTokenizerFactory(r"[a-z]+").tokenize("ab12cd ef") == \
+        ["ab", "cd", "ef"]
+
+
+# ------------------------------------------------------------------- RL
+@pytest.mark.slow
+def test_dqn_solves_gridworld():
+    from deeplearning4j_tpu.rl import (QLearningConfiguration,
+                                       QLearningDiscrete, SimpleGridWorld)
+    mdp = SimpleGridWorld(4)
+    conf = QLearningConfiguration(
+        seed=7, max_step=2500, batch_size=32, update_start=64,
+        target_dqn_update_freq=50, eps_decay_steps=1500,
+        learning_rate=2e-3, exp_replay_size=4000)
+    ql = QLearningDiscrete(mdp, conf, hidden=32)
+    rewards = ql.train()
+    assert len(rewards) > 5
+    # trained greedy policy must reach the goal (reward approx. +1)
+    policy = ql.get_policy()
+    total = policy.play(SimpleGridWorld(4), max_steps=40)
+    assert total > 0.8, total
+
+
+def test_replay_buffer_ring():
+    from deeplearning4j_tpu.rl import ReplayBuffer
+    rb = ReplayBuffer(4, 2, seed=0)
+    for i in range(6):
+        rb.add([i, i], i % 4, float(i), [i + 1, i + 1], False)
+    assert len(rb) == 4
+    s, a, r, s2, d = rb.sample(8)
+    assert s.shape == (8, 2) and (r >= 2).all()  # oldest overwritten
+
+
+# -------------------------------------------------------------- Arbiter
+def test_arbiter_random_search_finds_good_config():
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.arbiter import (ContinuousParameterSpace,
+                                            IntegerParameterSpace,
+                                            OptimizationRunner,
+                                            RandomSearchGenerator)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                        OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] * x[:, 1] > 0).astype(int)]
+    train = ListDataSetIterator(DataSet(x[:192], y[:192]).batch_by(48))
+    test = ListDataSetIterator(DataSet(x[192:], y[192:]).batch_by(64))
+
+    space = {"lr": ContinuousParameterSpace(1e-4, 0.3, log_scale=True),
+             "hidden": IntegerParameterSpace(4, 32)}
+
+    def build(params):
+        conf = (NeuralNetConfiguration.builder().seed(9)
+                .updater(Adam(learning_rate=params["lr"])).list()
+                .layer(DenseLayer(n_in=6, n_out=params["hidden"],
+                                  activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def score(model, params):
+        model.fit(train, n_epochs=20)
+        return model.evaluate(test).accuracy()
+
+    res = OptimizationRunner(
+        RandomSearchGenerator(space, seed=4), build, score,
+        max_candidates=6).execute()
+    assert res.best_score > 0.8, [r["score"] for r in res.all_results]
+    assert len(res.all_results) == 6
+    assert 1e-4 <= res.best_candidate["lr"] <= 0.3
+
+
+def test_arbiter_grid_search_covers_product():
+    from deeplearning4j_tpu.arbiter import (DiscreteParameterSpace,
+                                            GridSearchGenerator,
+                                            IntegerParameterSpace,
+                                            OptimizationRunner)
+    space = {"a": DiscreteParameterSpace(["x", "y"]),
+             "b": IntegerParameterSpace(1, 3)}
+    seen = []
+    res = OptimizationRunner(
+        GridSearchGenerator(space, discretization=3),
+        model_builder=lambda p: None,
+        scorer=lambda m, p: (seen.append(p), p["b"])[1],
+        max_candidates=100).execute()
+    assert len(seen) == 6  # 2 x 3 full product
+    assert res.best_candidate["b"] == 3
