@@ -26,7 +26,12 @@ from deeplearning4j_tpu.parallel.checkpoint import (
 # DL4J-familiar alias: `initialize_distributed` ≙ Spark/Aeron bring-up
 initialize_distributed = initialize
 
+from deeplearning4j_tpu.parallel.ring_attention import (
+    ring_attention, ring_self_attention)
+from deeplearning4j_tpu.parallel.scaling import measure_scaling
+
 __all__ = ["MeshConfig", "ShardedTrainer", "ParallelInference",
            "initialize", "initialize_distributed", "global_mesh",
            "host_local_batch_to_global", "ShardedCheckpointer",
-           "CheckpointListener"]
+           "CheckpointListener", "ring_attention", "ring_self_attention",
+           "measure_scaling"]

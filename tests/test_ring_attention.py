@@ -1,0 +1,80 @@
+"""Ring attention (sequence parallelism) on the virtual mesh: exact
+numeric equality with full attention, gradient flow through ppermute,
+masking, and a dp x sp mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel.mesh import MeshConfig
+from deeplearning4j_tpu.parallel.ring_attention import (
+    full_attention_reference, ring_self_attention)
+
+
+def _qkv(b=2, h=2, t=32, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.float32)
+                 for _ in range(3))
+
+
+def test_ring_matches_full_attention_8way():
+    mesh = MeshConfig(sequence=8).build()
+    q, k, v = _qkv()
+    out = ring_self_attention(mesh, q, k, v)
+    ref = full_attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+
+def test_ring_with_padding_mask():
+    mesh = MeshConfig(sequence=4).build(jax.devices()[:4])
+    q, k, v = _qkv(t=16)
+    mask = np.ones((2, 16), np.float32)
+    mask[:, 12:] = 0
+    mask = jnp.asarray(mask)
+    out = ring_self_attention(mesh, q, k, v, mask)
+    ref = full_attention_reference(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+    # masked keys truly cannot influence the output
+    k2 = k.at[:, :, 12:].set(999.0)
+    v2 = v.at[:, :, 12:].set(-999.0)
+    out2 = ring_self_attention(mesh, q, k2, v2, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                               atol=2e-5)
+
+
+def test_ring_gradients_match_full():
+    mesh = MeshConfig(sequence=4).build(jax.devices()[:4])
+    q, k, v = _qkv(t=16)
+
+    def loss_ring(qkv):
+        return jnp.sum(jnp.square(ring_self_attention(mesh, *qkv)))
+
+    def loss_full(qkv):
+        return jnp.sum(jnp.square(full_attention_reference(*qkv)))
+
+    g_ring = jax.grad(loss_ring)((q, k, v))
+    g_full = jax.grad(loss_full)((q, k, v))
+    for gr, gf in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   atol=5e-4)
+
+
+def test_ring_on_data_x_sequence_mesh():
+    """dp x sp: batch sharded over 'data', sequence over 'sequence' —
+    the long-context layout for multi-host training."""
+    mesh = MeshConfig(data=2, sequence=4).build()
+    q, k, v = _qkv(b=4, t=16)
+    out = ring_self_attention(mesh, q, k, v)
+    ref = full_attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+
+def test_ring_requires_sequence_axis():
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(4), ("data",))
+    q, k, v = _qkv(t=16)
+    with pytest.raises(Exception):
+        ring_self_attention(mesh, q, k, v)
