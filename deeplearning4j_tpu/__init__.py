@@ -24,10 +24,12 @@ __version__ = "0.1.0"
 from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
 from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
 from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+from deeplearning4j_tpu import native_io
 
 __all__ = [
     "NeuralNetConfiguration",
     "MultiLayerNetwork",
     "ComputationGraph",
+    "native_io",
     "__version__",
 ]

@@ -88,6 +88,10 @@ class OptimizationRunner:
             if better:
                 best_score, best_params, best_model = score, params, model
         if best_params is None:
+            if results:
+                raise ValueError(
+                    f"All {len(results)} candidate scores were NaN — "
+                    "the scorer diverged on every configuration")
             raise ValueError("No candidates were evaluated")
         return OptimizationResult(best_params, best_score, best_model,
                                   results)

@@ -109,22 +109,18 @@ class Word2Vec:
 
         return jax.jit(step, donate_argnums=(0, 1))
 
-    def fit(self, sentences: Sequence[str]) -> List[float]:
-        token_lists = [self.tokenizer_factory.tokenize(s)
-                       for s in sentences]
-        self._build_vocab(token_lists)
-        n_vocab = len(self.vocab)
-        if n_vocab == 0:
-            raise ValueError("Empty vocabulary (check min_word_frequency)")
-        rng = np.random.default_rng(self.seed)
+    def _train_pairs(self, pairs_all: np.ndarray, n_vocab: int,
+                     n_rows: int, rng: np.random.Generator):
+        """The shared NS-SGD loop: epochs x shuffled batches with linear
+        LR decay.  ``n_rows`` sizes syn0 (== n_vocab for Word2Vec;
+        + n_docs for ParagraphVectors).  Returns (syn0, syn1, losses)."""
         d = self.vector_size
         syn0 = jnp.asarray(
-            (rng.random((n_vocab, d)) - 0.5) / d, jnp.float32)
+            (rng.random((n_rows, d)) - 0.5) / d, jnp.float32)
         syn1 = jnp.zeros((n_vocab, d), jnp.float32)
         step = self._make_step(n_vocab)
         key = jax.random.key(self.seed)
-        losses = []
-        pairs_all = self._pairs(token_lists, rng)
+        losses: List[float] = []
         n_batches_total = max(
             1, self.epochs * ((len(pairs_all) + self.batch_size - 1)
                               // self.batch_size))
@@ -145,8 +141,19 @@ class Word2Vec:
                     sub)
                 losses.append(float(loss))
                 t += 1
-        self.syn0 = np.asarray(syn0)
-        self.syn1 = np.asarray(syn1)
+        return np.asarray(syn0), np.asarray(syn1), losses
+
+    def fit(self, sentences: Sequence[str]) -> List[float]:
+        token_lists = [self.tokenizer_factory.tokenize(s)
+                       for s in sentences]
+        self._build_vocab(token_lists)
+        n_vocab = len(self.vocab)
+        if n_vocab == 0:
+            raise ValueError("Empty vocabulary (check min_word_frequency)")
+        rng = np.random.default_rng(self.seed)
+        pairs_all = self._pairs(token_lists, rng)
+        self.syn0, self.syn1, losses = self._train_pairs(
+            pairs_all, n_vocab, n_vocab, rng)
         return losses
 
     # ------------------------------------------------------------------
@@ -185,46 +192,24 @@ class ParagraphVectors(Word2Vec):
         token_lists = [self.tokenizer_factory.tokenize(s)
                        for s in documents]
         self._build_vocab(token_lists)
-        n_vocab, n_docs, d = len(self.vocab), len(documents), self.vector_size
+        n_vocab, n_docs = len(self.vocab), len(documents)
         rng = np.random.default_rng(self.seed)
-        # doc ids live in the same embedding table after the words:
-        # pairs (doc_id + n_vocab, word) reuse the word2vec step verbatim.
-        pairs = []
-        for di, toks in enumerate(token_lists):
-            for t in toks:
-                if t in self.vocab:
-                    pairs.append((n_vocab + di, self.vocab[t]))
-        pairs_all = np.asarray(pairs, np.int32)
-        rng.shuffle(pairs_all)
-        syn0 = jnp.asarray((rng.random((n_vocab + n_docs, d)) - 0.5) / d,
-                           jnp.float32)
-        syn1 = jnp.zeros((n_vocab, d), jnp.float32)
-        step = self._make_step(n_vocab)
-        key = jax.random.key(self.seed)
-        losses = []
-        n_batches_total = max(
-            1, self.epochs * ((len(pairs_all) + self.batch_size - 1)
-                              // self.batch_size))
-        t = 0
-        for _ in range(self.epochs):
-            rng.shuffle(pairs_all)
-            for k in range(0, len(pairs_all), self.batch_size):
-                batch = pairs_all[k:k + self.batch_size]
-                if len(batch) < 2:
-                    continue
-                lr = max(self.min_learning_rate,
-                         self.learning_rate * (1 - t / n_batches_total))
-                key, sub = jax.random.split(key)
-                syn0, syn1, loss = step(
-                    syn0, syn1, jnp.asarray(batch[:, 0]),
-                    jnp.asarray(batch[:, 1]),
-                    jnp.asarray(lr, jnp.float32), sub)
-                losses.append(float(loss))
-                t += 1
-        full = np.asarray(syn0)
+        # Doc ids live in the same embedding table after the words, so
+        # (doc_id + n_vocab, word) pairs reuse the word2vec step; the
+        # word-window pairs are ALSO included so word vectors co-train
+        # (DL4J trainWordVectors=true default — doc-only pairs would
+        # leave syn0's word rows at their random init).
+        doc_pairs = [(n_vocab + di, self.vocab[t])
+                     for di, toks in enumerate(token_lists)
+                     for t in toks if t in self.vocab]
+        word_pairs = self._pairs(token_lists, rng)
+        pairs_all = np.concatenate(
+            [word_pairs.reshape(-1, 2),
+             np.asarray(doc_pairs, np.int32).reshape(-1, 2)])
+        full, self.syn1, losses = self._train_pairs(
+            pairs_all, n_vocab, n_vocab + n_docs, rng)
         self.syn0 = full[:n_vocab]
         self.doc_vectors = full[n_vocab:]
-        self.syn1 = np.asarray(syn1)
         return losses
 
     def get_doc_vector(self, i: int) -> np.ndarray:

@@ -1,0 +1,173 @@
+#include "csv_loader.h"
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+/* Whole-file read (streaming would complicate chunk splitting; training
+ * CSVs fit host RAM by construction — they become one device batch). */
+int read_file(const char* path, std::string* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(static_cast<size_t>(size));
+  size_t got = size ? std::fread(&(*out)[0], 1, size, f) : 0;
+  std::fclose(f);
+  return got == static_cast<size_t>(size) ? 0 : -2;
+}
+
+/* [start, end) line-aligned offsets of data lines after skip_lines. */
+void data_region(const std::string& buf, int skip_lines, size_t* start) {
+  size_t pos = 0;
+  for (int i = 0; i < skip_lines && pos < buf.size(); ++i) {
+    size_t nl = buf.find('\n', pos);
+    pos = (nl == std::string::npos) ? buf.size() : nl + 1;
+  }
+  *start = pos;
+}
+
+int parse_lines(const char* p, const char* end, char delim, float* out,
+                int64_t n_cols, int64_t* rows_done) {
+  int64_t row = 0;
+  while (p < end) {
+    /* skip empty lines */
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    for (int64_t c = 0; c < n_cols; ++c) {
+      char* next = nullptr;
+      errno = 0;
+      float v = std::strtof(p, &next);
+      if (next == p || errno == ERANGE) return -3;
+      out[row * n_cols + c] = v;
+      p = next;
+      if (c + 1 < n_cols) {
+        if (p < end && *p == delim) ++p;
+        else return -4; /* too few columns */
+      }
+    }
+    while (p < end && *p != '\n') ++p; /* trailing cr/extra ignored */
+    if (p < end) ++p;
+    ++row;
+  }
+  *rows_done = row;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int dl4j_csv_dims(const char* path, int skip_lines, char delimiter,
+                  int64_t* n_rows, int64_t* n_cols) {
+  std::string buf;
+  int rc = read_file(path, &buf);
+  if (rc) return rc;
+  size_t start;
+  data_region(buf, skip_lines, &start);
+  int64_t rows = 0, cols = 0;
+  bool first = true;
+  size_t pos = start;
+  while (pos < buf.size()) {
+    size_t nl = buf.find('\n', pos);
+    size_t line_end = (nl == std::string::npos) ? buf.size() : nl;
+    bool empty = true;
+    for (size_t i = pos; i < line_end; ++i)
+      if (buf[i] != '\r' && buf[i] != ' ') { empty = false; break; }
+    if (!empty) {
+      ++rows;
+      if (first) {
+        cols = 1;
+        for (size_t i = pos; i < line_end; ++i)
+          if (buf[i] == delimiter) ++cols;
+        first = false;
+      }
+    }
+    pos = (nl == std::string::npos) ? buf.size() : nl + 1;
+  }
+  *n_rows = rows;
+  *n_cols = cols;
+  return 0;
+}
+
+int dl4j_csv_parse(const char* path, int skip_lines, char delimiter,
+                   float* out, int64_t n_rows, int64_t n_cols,
+                   int n_threads) {
+  std::string buf;
+  int rc = read_file(path, &buf);
+  if (rc) return rc;
+  size_t start;
+  data_region(buf, skip_lines, &start);
+  const char* base = buf.data();
+  const char* end = base + buf.size();
+
+  if (n_threads <= 1) {
+    int64_t done = 0;
+    rc = parse_lines(base + start, end, delimiter, out, n_cols, &done);
+    if (rc) return rc;
+    return done == n_rows ? 0 : -5;
+  }
+
+  /* line-aligned chunk boundaries with their starting row index; a
+   * line counts as a row ONLY under the same rule dl4j_csv_dims uses
+   * (some non-{'\r',' '} char), so chunk write offsets can never
+   * drift past the caller's n_rows allocation. */
+  std::vector<size_t> bounds{start};
+  std::vector<int64_t> row_at{0};
+  int64_t rows_seen = 0;
+  size_t pos = start;
+  size_t target = (buf.size() - start) / n_threads;
+  size_t next_cut = start + target;
+  while (pos < buf.size()) {
+    size_t nl = buf.find('\n', pos);
+    size_t line_end = (nl == std::string::npos) ? buf.size() : nl;
+    bool empty = true;
+    for (size_t i = pos; i < line_end; ++i)
+      if (buf[i] != '\r' && buf[i] != ' ') { empty = false; break; }
+    if (!empty) ++rows_seen;
+    pos = (nl == std::string::npos) ? buf.size() : nl + 1;
+    if (pos >= next_cut && pos < buf.size() &&
+        bounds.size() < static_cast<size_t>(n_threads)) {
+      bounds.push_back(pos);
+      row_at.push_back(rows_seen);
+      next_cut = pos + target;
+    }
+  }
+  bounds.push_back(buf.size());
+  if (rows_seen != n_rows) return -5;
+
+  std::vector<int> rcs(bounds.size() - 1, 0);
+  std::vector<int64_t> dones(bounds.size() - 1, 0);
+  std::vector<std::thread> threads;
+  for (size_t t = 0; t < bounds.size() - 1; ++t) {
+    threads.emplace_back([&, t]() {
+      rcs[t] = parse_lines(base + bounds[t], base + bounds[t + 1],
+                           delimiter, out + row_at[t] * n_cols, n_cols,
+                           &dones[t]);
+    });
+  }
+  for (auto& th : threads) th.join();
+  for (int r : rcs)
+    if (r) return r;
+  /* every chunk must have parsed exactly the rows allotted to it */
+  for (size_t t = 0; t < dones.size(); ++t) {
+    int64_t expect = ((t + 1 < row_at.size()) ? row_at[t + 1] : n_rows)
+                     - row_at[t];
+    if (dones[t] != expect) return -5;
+  }
+  return 0;
+}
+
+void dl4j_u8_to_f32_scaled(const uint8_t* src, float* dst, int64_t n,
+                           float scale) {
+  for (int64_t i = 0; i < n; ++i) dst[i] = src[i] * scale;
+}
+
+}  /* extern "C" */

@@ -1,0 +1,41 @@
+/* Native CSV loader — the C ABI surface of the framework's native IO
+ * core.
+ *
+ * Role in the architecture: the reference keeps its hot IO in native
+ * code (libnd4j NativeOps buffer plumbing + JavaCV decode behind
+ * DataVec); here the XLA/PJRT runtime owns all device compute, so the
+ * native layer's job is HOST-side ETL throughput — parsing numeric
+ * text into ready-to-transfer float32 batches without Python
+ * object-per-cell overhead.  Exposed as a plain C ABI consumed via
+ * ctypes (the JavaCPP/JNI analogue, minus codegen).
+ *
+ * All functions return 0 on success, negative error codes otherwise.
+ */
+#ifndef DL4J_TPU_CSV_LOADER_H
+#define DL4J_TPU_CSV_LOADER_H
+
+#include <cstdint>
+
+extern "C" {
+
+/* Scan the file: number of data rows (after skip_lines, ignoring empty
+ * lines) and columns (from the first data row). */
+int dl4j_csv_dims(const char* path, int skip_lines, char delimiter,
+                  int64_t* n_rows, int64_t* n_cols);
+
+/* Parse the full file into a row-major float32 matrix [n_rows, n_cols]
+ * (buffer preallocated by the caller).  Non-numeric cells fail with -3.
+ * n_threads > 1 splits the file into line-aligned chunks parsed in
+ * parallel (std::thread). */
+int dl4j_csv_parse(const char* path, int skip_lines, char delimiter,
+                   float* out, int64_t n_rows, int64_t n_cols,
+                   int n_threads);
+
+/* uint8 HWC image batch -> float32 scaled by 1/255 (the
+ * ImagePreProcessingScaler hot loop, SIMD-vectorized by the compiler). */
+void dl4j_u8_to_f32_scaled(const uint8_t* src, float* dst, int64_t n,
+                           float scale);
+
+}  /* extern "C" */
+
+#endif

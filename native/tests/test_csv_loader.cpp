@@ -1,0 +1,98 @@
+/* Native-core tests (the libnd4j tests_cpu/ role, assert-harness since
+ * the image ships no gtest and has no egress). */
+#include "../src/csv_loader.h"
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+static int failures = 0;
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,      \
+                   #cond);                                              \
+      ++failures;                                                       \
+    }                                                                   \
+  } while (0)
+
+static std::string write_tmp(const char* content) {
+  std::string path = "/tmp/dl4j_native_test_XXXXXX";
+  int fd = mkstemp(&path[0]);
+  FILE* f = fdopen(fd, "w");
+  std::fputs(content, f);
+  std::fclose(f);
+  return path;
+}
+
+static void test_dims_and_parse() {
+  std::string p = write_tmp("# header\n1,2.5,3\n-4,5e-1,6\n\n7,8,9\n");
+  int64_t rows = 0, cols = 0;
+  CHECK(dl4j_csv_dims(p.c_str(), 1, ',', &rows, &cols) == 0);
+  CHECK(rows == 3);
+  CHECK(cols == 3);
+  float out[9];
+  CHECK(dl4j_csv_parse(p.c_str(), 1, ',', out, rows, cols, 1) == 0);
+  CHECK(out[0] == 1.0f);
+  CHECK(std::fabs(out[1] - 2.5f) < 1e-6);
+  CHECK(out[3] == -4.0f);
+  CHECK(std::fabs(out[4] - 0.5f) < 1e-6);
+  CHECK(out[8] == 9.0f);
+  std::remove(p.c_str());
+}
+
+static void test_threaded_matches_serial() {
+  std::string content;
+  for (int i = 0; i < 1000; ++i) {
+    char line[64];
+    std::snprintf(line, sizeof line, "%d,%d.5,%d\n", i, i, i * 2);
+    content += line;
+    if (i % 97 == 0) content += "  \r\n";  /* junk whitespace lines */
+  }
+  std::string p = write_tmp(content.c_str());
+  int64_t rows, cols;
+  CHECK(dl4j_csv_dims(p.c_str(), 0, ',', &rows, &cols) == 0);
+  CHECK(rows == 1000 && cols == 3);
+  std::string serial(rows * cols * 4, '\0'), par(rows * cols * 4, '\0');
+  float* s = reinterpret_cast<float*>(&serial[0]);
+  float* m = reinterpret_cast<float*>(&par[0]);
+  CHECK(dl4j_csv_parse(p.c_str(), 0, ',', s, rows, cols, 1) == 0);
+  CHECK(dl4j_csv_parse(p.c_str(), 0, ',', m, rows, cols, 4) == 0);
+  CHECK(std::memcmp(s, m, rows * cols * 4) == 0);
+  std::remove(p.c_str());
+}
+
+static void test_errors() {
+  std::string p = write_tmp("1,abc,3\n");
+  int64_t rows, cols;
+  CHECK(dl4j_csv_dims(p.c_str(), 0, ',', &rows, &cols) == 0);
+  float out[3];
+  CHECK(dl4j_csv_parse(p.c_str(), 0, ',', out, rows, cols, 1) == -3);
+  std::remove(p.c_str());
+  int64_t r2, c2;
+  CHECK(dl4j_csv_dims("/nonexistent/file.csv", 0, ',', &r2, &c2) == -1);
+}
+
+static void test_u8_scale() {
+  uint8_t src[4] = {0, 51, 102, 255};
+  float dst[4];
+  dl4j_u8_to_f32_scaled(src, dst, 4, 1.0f / 255.0f);
+  CHECK(std::fabs(dst[0]) < 1e-9);
+  CHECK(std::fabs(dst[1] - 0.2f) < 1e-6);
+  CHECK(std::fabs(dst[3] - 1.0f) < 1e-6);
+}
+
+int main() {
+  test_dims_and_parse();
+  test_threaded_matches_serial();
+  test_errors();
+  test_u8_scale();
+  if (failures) {
+    std::fprintf(stderr, "%d failure(s)\n", failures);
+    return 1;
+  }
+  std::puts("ALL NATIVE TESTS PASSED");
+  return 0;
+}

@@ -1,0 +1,122 @@
+"""Native IO core: CMake build, C ABI binding, parity with the Python
+reader, and the benchmark justification SURVEY §7 demanded for any
+native component."""
+import os
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native_io
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+
+@pytest.fixture(scope="module")
+def built():
+    if not native_io.native_available():
+        native_io.build_native()
+    assert native_io.native_available()
+    return True
+
+
+@pytest.fixture(scope="module")
+def big_csv(tmp_path_factory):
+    p = tmp_path_factory.mktemp("csv") / "big.csv"
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(20000, 12)).astype(np.float32)
+    with open(p, "w") as f:
+        f.write("# header line\n")
+        for row in data:
+            f.write(",".join(f"{v:.6f}" for v in row) + "\n")
+    return str(p), data
+
+
+def test_cpp_unit_tests_pass(built):
+    exe = os.path.join(NATIVE_DIR, "build", "test_csv_loader")
+    r = subprocess.run([exe], capture_output=True, timeout=120)
+    assert r.returncode == 0, r.stderr.decode()
+    assert b"ALL NATIVE TESTS PASSED" in r.stdout
+
+
+def test_native_csv_matches_python_reader(built, big_csv):
+    path, data = big_csv
+    m = native_io.load_csv_native(path, skip_lines=1)
+    assert m.shape == data.shape
+    np.testing.assert_allclose(m, data, atol=1e-5)
+
+    from deeplearning4j_tpu.datavec import CSVRecordReader
+    py_rows = np.asarray(list(CSVRecordReader(path, skip_lines=1)),
+                         np.float32)
+    np.testing.assert_allclose(m, py_rows, atol=1e-5)
+
+
+def test_native_csv_is_faster(built, big_csv):
+    """The benchmark justification: native parse must beat the Python
+    csv+float() path by a clear margin or the native layer has no right
+    to exist (SURVEY §7 hard part (d))."""
+    path, _ = big_csv
+    from deeplearning4j_tpu.datavec import CSVRecordReader
+
+    t0 = time.perf_counter()
+    native_io.load_csv_native(path, skip_lines=1, n_threads=1)
+    t_native = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    list(CSVRecordReader(path, skip_lines=1))
+    t_python = time.perf_counter() - t0
+
+    speedup = t_python / t_native
+    print(f"\nnative csv speedup: {speedup:.1f}x "
+          f"({t_python*1e3:.0f}ms -> {t_native*1e3:.0f}ms)")
+    assert speedup > 3.0, (t_python, t_native)
+
+
+def test_native_reader_feeds_training(built, big_csv, tmp_path):
+    """NativeCSVRecordReader slots into the standard ETL bridge."""
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(300, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(int)
+    p = tmp_path / "train.csv"
+    with open(p, "w") as f:
+        for row, c in zip(x, y):
+            f.write(",".join(f"{v:.5f}" for v in row) + f",{c}\n")
+
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.datavec import RecordReaderDataSetIterator
+    from deeplearning4j_tpu.native_io import NativeCSVRecordReader
+    from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                        OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    it = RecordReaderDataSetIterator(
+        NativeCSVRecordReader(str(p)), batch_size=64, label_index=-1,
+        n_classes=2)
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Adam(learning_rate=0.05)).list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    model.fit(it, n_epochs=20)
+    assert model.evaluate(it).accuracy() > 0.95
+
+
+def test_u8_scale_matches_numpy(built):
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, (32, 32, 3), np.uint8)
+    out = native_io.u8_to_f32_scaled(img)
+    np.testing.assert_allclose(out, img.astype(np.float32) / 255.0,
+                               atol=1e-7)
+
+
+def test_native_error_paths(built, tmp_path):
+    with pytest.raises(IOError):
+        native_io.load_csv_native("/nonexistent.csv")
+    bad = tmp_path / "bad.csv"
+    bad.write_text("1,banana,3\n")
+    with pytest.raises(ValueError, match="non-numeric"):
+        native_io.load_csv_native(str(bad))

@@ -64,6 +64,9 @@ def test_paragraph_vectors_separate_topics():
     within = (a @ a.mean(0)).mean() + (t @ t.mean(0)).mean()
     across = (a @ t.mean(0)).mean() + (t @ a.mean(0)).mean()
     assert within > across, (within, across)
+    # word vectors CO-TRAIN (regression: doc-only pairs left them at
+    # their random init)
+    assert pv.similarity("cat", "dog") > pv.similarity("cat", "gpu")
 
 
 def test_tokenizers():
