@@ -35,12 +35,15 @@ void data_region(const std::string& buf, int skip_lines, size_t* start) {
 }
 
 int parse_lines(const char* p, const char* end, char delim, float* out,
-                int64_t n_cols, int64_t* rows_done) {
+                int64_t n_cols, int64_t max_rows, int64_t* rows_done) {
   int64_t row = 0;
   while (p < end) {
-    /* skip empty lines */
-    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    /* skip empty/whitespace-only line content (same "empty" rule as
+     * dl4j_csv_dims, which does not count such lines as rows) */
+    while (p < end && (*p == '\n' || *p == '\r' || *p == ' '
+                       || *p == '\t')) ++p;
     if (p >= end) break;
+    if (row >= max_rows) return -5;  /* more data than the caller sized */
     for (int64_t c = 0; c < n_cols; ++c) {
       char* next = nullptr;
       errno = 0;
@@ -110,7 +113,8 @@ int dl4j_csv_parse(const char* path, int skip_lines, char delimiter,
 
   if (n_threads <= 1) {
     int64_t done = 0;
-    rc = parse_lines(base + start, end, delimiter, out, n_cols, &done);
+    rc = parse_lines(base + start, end, delimiter, out, n_cols, n_rows,
+                     &done);
     if (rc) return rc;
     return done == n_rows ? 0 : -5;
   }
@@ -148,9 +152,11 @@ int dl4j_csv_parse(const char* path, int skip_lines, char delimiter,
   std::vector<std::thread> threads;
   for (size_t t = 0; t < bounds.size() - 1; ++t) {
     threads.emplace_back([&, t]() {
+      int64_t quota = ((t + 1 < row_at.size()) ? row_at[t + 1] : n_rows)
+                      - row_at[t];
       rcs[t] = parse_lines(base + bounds[t], base + bounds[t + 1],
                            delimiter, out + row_at[t] * n_cols, n_cols,
-                           &dones[t]);
+                           quota, &dones[t]);
     });
   }
   for (auto& th : threads) th.join();

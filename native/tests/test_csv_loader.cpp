@@ -64,6 +64,24 @@ static void test_threaded_matches_serial() {
   std::remove(p.c_str());
 }
 
+static void test_trailing_whitespace_line() {
+  std::string p = write_tmp("1,2\n  \n");
+  int64_t rows, cols;
+  CHECK(dl4j_csv_dims(p.c_str(), 0, ',', &rows, &cols) == 0);
+  CHECK(rows == 1 && cols == 2);
+  float out[2];
+  CHECK(dl4j_csv_parse(p.c_str(), 0, ',', out, rows, cols, 1) == 0);
+  CHECK(out[0] == 1.0f && out[1] == 2.0f);
+  std::remove(p.c_str());
+}
+
+static void test_undersized_buffer_rejected() {
+  std::string p = write_tmp("1,2\n3,4\n5,6\n");
+  float out[4];  /* claim 2 rows although the file has 3 */
+  CHECK(dl4j_csv_parse(p.c_str(), 0, ',', out, 2, 2, 1) == -5);
+  std::remove(p.c_str());
+}
+
 static void test_errors() {
   std::string p = write_tmp("1,abc,3\n");
   int64_t rows, cols;
@@ -87,6 +105,8 @@ static void test_u8_scale() {
 int main() {
   test_dims_and_parse();
   test_threaded_matches_serial();
+  test_trailing_whitespace_line();
+  test_undersized_buffer_rejected();
   test_errors();
   test_u8_scale();
   if (failures) {
