@@ -243,6 +243,12 @@ class _Importer:
                               axis=_tf_attr(node, "axis", -1))
         if op == "Range":
             return self._emit(node, "range", ins)
+        if op == "Cumsum":
+            if _tf_attr(node, "exclusive", False) or _tf_attr(
+                    node, "reverse", False):
+                raise NotImplementedError("exclusive/reverse Cumsum")
+            axis = int(np.asarray(self._const_of(ins[1])).reshape(()))
+            return self._emit(node, "cumsum", ins[:1], axis=axis)
         if op in ("Pad", "PadV2", "MirrorPad"):
             if op == "MirrorPad":
                 raise NotImplementedError("MirrorPad")

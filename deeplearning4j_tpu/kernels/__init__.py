@@ -1,0 +1,8 @@
+"""Hand-written Pallas TPU kernels for the ops where XLA's automatic
+fusion leaves throughput on the table — the role the reference filled
+with hand-optimized CUDA helpers (``libnd4j/.../helpers/cuda``), except
+each kernel here is a few dozen lines of Python lowered through Mosaic.
+"""
+from deeplearning4j_tpu.kernels.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

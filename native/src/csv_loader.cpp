@@ -24,6 +24,12 @@ int read_file(const char* path, std::string* out) {
   return got == static_cast<size_t>(size) ? 0 : -2;
 }
 
+/* A char that may appear on a "blank" line; the active delimiter is
+ * never blank (a leading empty field like "\t1\t2" must survive). */
+inline bool is_blank_char(char c, char delim) {
+  return c != delim && (c == '\r' || c == ' ' || c == '\t');
+}
+
 /* [start, end) line-aligned offsets of data lines after skip_lines. */
 void data_region(const std::string& buf, int skip_lines, size_t* start) {
   size_t pos = 0;
@@ -38,10 +44,9 @@ int parse_lines(const char* p, const char* end, char delim, float* out,
                 int64_t n_cols, int64_t max_rows, int64_t* rows_done) {
   int64_t row = 0;
   while (p < end) {
-    /* skip empty/whitespace-only line content (same "empty" rule as
+    /* skip empty/blank-only line content (the same "empty" rule as
      * dl4j_csv_dims, which does not count such lines as rows) */
-    while (p < end && (*p == '\n' || *p == '\r' || *p == ' '
-                       || *p == '\t')) ++p;
+    while (p < end && (*p == '\n' || is_blank_char(*p, delim))) ++p;
     if (p >= end) break;
     if (row >= max_rows) return -5;  /* more data than the caller sized */
     for (int64_t c = 0; c < n_cols; ++c) {
@@ -83,7 +88,7 @@ int dl4j_csv_dims(const char* path, int skip_lines, char delimiter,
     size_t line_end = (nl == std::string::npos) ? buf.size() : nl;
     bool empty = true;
     for (size_t i = pos; i < line_end; ++i)
-      if (buf[i] != '\r' && buf[i] != ' ') { empty = false; break; }
+      if (!is_blank_char(buf[i], delimiter)) { empty = false; break; }
     if (!empty) {
       ++rows;
       if (first) {
@@ -134,7 +139,7 @@ int dl4j_csv_parse(const char* path, int skip_lines, char delimiter,
     size_t line_end = (nl == std::string::npos) ? buf.size() : nl;
     bool empty = true;
     for (size_t i = pos; i < line_end; ++i)
-      if (buf[i] != '\r' && buf[i] != ' ') { empty = false; break; }
+      if (!is_blank_char(buf[i], delimiter)) { empty = false; break; }
     if (!empty) ++rows_seen;
     pos = (nl == std::string::npos) ? buf.size() : nl + 1;
     if (pos >= next_cut && pos < buf.size() &&

@@ -75,6 +75,27 @@ static void test_trailing_whitespace_line() {
   std::remove(p.c_str());
 }
 
+static void test_tab_lines_and_tab_delimiter() {
+  /* tab-only line is blank for comma CSVs */
+  std::string p = write_tmp("1,2\n\t\n3,4\n");
+  int64_t rows, cols;
+  CHECK(dl4j_csv_dims(p.c_str(), 0, ',', &rows, &cols) == 0);
+  CHECK(rows == 2 && cols == 2);
+  float out[4];
+  CHECK(dl4j_csv_parse(p.c_str(), 0, ',', out, rows, cols, 1) == 0);
+  CHECK(out[2] == 3.0f);
+  std::remove(p.c_str());
+  /* tab DELIMITER: leading empty field must not be eaten... strtof on
+   * an empty field fails -3, which is at least loud, but a normal
+   * tab-separated file parses fine */
+  std::string p2 = write_tmp("1\t2\n3\t4\n");
+  CHECK(dl4j_csv_dims(p2.c_str(), 0, '\t', &rows, &cols) == 0);
+  CHECK(rows == 2 && cols == 2);
+  CHECK(dl4j_csv_parse(p2.c_str(), 0, '\t', out, rows, cols, 1) == 0);
+  CHECK(out[1] == 2.0f && out[3] == 4.0f);
+  std::remove(p2.c_str());
+}
+
 static void test_undersized_buffer_rejected() {
   std::string p = write_tmp("1,2\n3,4\n5,6\n");
   float out[4];  /* claim 2 rows although the file has 3 */
@@ -106,6 +127,7 @@ int main() {
   test_dims_and_parse();
   test_threaded_matches_serial();
   test_trailing_whitespace_line();
+  test_tab_lines_and_tab_delimiter();
   test_undersized_buffer_rejected();
   test_errors();
   test_u8_scale();

@@ -72,7 +72,8 @@ CASES = [
     ("where", lambda a, b: tf.where(a > 0, a, b), (A34, B34)),
     ("cast", lambda a: tf.cast(tf.cast(a, tf.int32), tf.float32), (A34,)),
     ("greater", lambda a, b: tf.cast(a > b, tf.float32), (A34, B34)),
-    ("cumsum_axis", lambda a: tf.math.reduce_prod(a, axis=1), (POS34,)),
+    ("reduce_prod", lambda a: tf.math.reduce_prod(a, axis=1), (POS34,)),
+    ("cumsum", lambda a: tf.cumsum(a, axis=1), (A34,)),
     ("broadcast", lambda a: a + tf.ones((3, 1)), (A34,)),
     ("einsum", lambda a, b: tf.einsum("ij,jk->ik", a, b), (A34, M45)),
 ]
@@ -84,7 +85,7 @@ def _import_and_run(fn, inputs):
     sd = import_graph_def(gd, trainable_consts=False)
     # placeholders are named a0, a1, ... by _freeze
     feeds = {f"a{i}": x for i, x in enumerate(inputs)}
-    outs = sd.output(feeds) if feeds else sd.output({})
+    outs = sd.output(feeds)
     ref = fn(*[tf.constant(x) for x in inputs]).numpy()
     # the frozen graph's output is an Identity node
     got = np.asarray(outs.get("Identity",
