@@ -260,6 +260,10 @@ class SelfAttentionLayer(BaseLayerConf):
     project_input: bool = True
     n_in: Optional[int] = None
     n_out: Optional[int] = None
+    # Route the unmasked path through the Pallas flash kernel (TPU; CPU
+    # uses its interpret mode).  Falls back to the einsum path whenever
+    # a mask is present or the sequence doesn't tile.
+    use_flash: bool = False
 
     WANTED_KINDS = ("rnn",)
     USES_MASK = True
@@ -300,6 +304,16 @@ class SelfAttentionLayer(BaseLayerConf):
         b, t, _ = q.shape
         split = lambda z: z.reshape(b, -1, h, s).transpose(0, 2, 1, 3)
         q, k, v = split(q), split(k), split(v)
+        if (self.use_flash and mask is None
+                and q.shape[2] == k.shape[2]):
+            from deeplearning4j_tpu.kernels import flash_attention
+            # largest block <= 512 that tiles t, so opting in stays
+            # honored for any length (t=768 -> 256, t=1000 -> 500, ...)
+            t_len = q.shape[2]
+            blk = next((bs for bs in range(min(512, t_len), 0, -1)
+                        if t_len % bs == 0))
+            out = flash_attention(q, k, v, blk, blk)
+            return out.transpose(0, 2, 1, 3).reshape(b, -1, h * s)
         logits = jnp.einsum("bhqs,bhks->bhqk", q, k) / jnp.sqrt(
             jnp.asarray(s, q.dtype))
         if mask is not None:

@@ -27,7 +27,10 @@ int read_file(const char* path, std::string* out) {
 /* A char that may appear on a "blank" line; the active delimiter is
  * never blank (a leading empty field like "\t1\t2" must survive). */
 inline bool is_blank_char(char c, char delim) {
-  return c != delim && (c == '\r' || c == ' ' || c == '\t');
+  /* Space stays blank even for delimiter ' ' (empty space-delimited
+   * fields are unrepresentable — strtof skips spaces — so only a tab
+   * delimiter needs protecting from the blank set). */
+  return c == '\r' || c == ' ' || (c == '\t' && delim != '\t');
 }
 
 /* [start, end) line-aligned offsets of data lines after skip_lines. */

@@ -96,6 +96,17 @@ static void test_tab_lines_and_tab_delimiter() {
   std::remove(p2.c_str());
 }
 
+static void test_space_delimiter_trailing_blank() {
+  std::string p = write_tmp("1 2\n3 4\n   \n");
+  int64_t rows, cols;
+  CHECK(dl4j_csv_dims(p.c_str(), 0, ' ', &rows, &cols) == 0);
+  CHECK(rows == 2 && cols == 2);
+  float out[4];
+  CHECK(dl4j_csv_parse(p.c_str(), 0, ' ', out, rows, cols, 1) == 0);
+  CHECK(out[3] == 4.0f);
+  std::remove(p.c_str());
+}
+
 static void test_undersized_buffer_rejected() {
   std::string p = write_tmp("1,2\n3,4\n5,6\n");
   float out[4];  /* claim 2 rows although the file has 3 */
@@ -128,6 +139,7 @@ int main() {
   test_threaded_matches_serial();
   test_trailing_whitespace_line();
   test_tab_lines_and_tab_delimiter();
+  test_space_delimiter_trailing_blank();
   test_undersized_buffer_rejected();
   test_errors();
   test_u8_scale();

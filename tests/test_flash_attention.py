@@ -54,3 +54,29 @@ def test_flash_rejects_ragged_blocks():
     q, k, v = _qkv(t=48)
     with pytest.raises(ValueError, match="divisible"):
         flash_attention(q, k, v, blk_q=32, blk_k=32)
+
+
+def test_self_attention_layer_flash_flag_parity():
+    """SelfAttentionLayer(use_flash=True) must produce the same outputs
+    as the einsum path (flash engages only on the unmasked path)."""
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers_misc import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.conf.layers_recurrent import RnnOutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    def build(use_flash):
+        b = (NeuralNetConfiguration.builder().seed(3)
+             .updater(Sgd(learning_rate=0.1)).list()
+             .set_input_type(InputType.recurrent(8))
+             .layer(SelfAttentionLayer(n_heads=2, head_size=4, n_out=8,
+                                       use_flash=use_flash))
+             .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent")))
+        return MultiLayerNetwork(b.build()).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 16, 8)).astype(np.float32)
+    m_ein, m_flash = build(False), build(True)
+    np.testing.assert_allclose(np.asarray(m_flash.output(x)),
+                               np.asarray(m_ein.output(x)), atol=3e-5)
