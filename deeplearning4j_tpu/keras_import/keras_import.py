@@ -217,8 +217,31 @@ class KerasModelImport:
         for i, lc in enumerate(layers_cfg):
             if lc["class_name"] != "Flatten":
                 last_real = i
+        # A model ending Dense → Activation('softmax') must import as ONE
+        # OutputLayer (activation folded in), not DenseLayer+ActivationLayer
+        # — the latter leaves the network without a loss head and fails
+        # later in fit() with a confusing error (advisor round 2).
+        folded_act, skip_idx = None, None
+        if layers_cfg and layers_cfg[-1]["class_name"] == "Activation":
+            j = len(layers_cfg) - 2
+            # Only Flatten may sit between (it is shape-only and never
+            # emitted); a Dropout there changes training numerics, and a
+            # Dense with its own non-linearity composes two activations
+            # — both cases keep the un-folded import.
+            while j >= 0 and layers_cfg[j]["class_name"] == "Flatten":
+                j -= 1
+            if j >= 0 and layers_cfg[j]["class_name"] == "Dense" and \
+                    _act(layers_cfg[j]["config"].get("activation")) == \
+                    "identity":
+                skip_idx = len(layers_cfg) - 1
+                last_real = j
+                folded_act = layers_cfg[-1]["config"].get("activation")
         for i, lc in enumerate(layers_cfg):
+            if i == skip_idx:
+                continue
             cls, c = lc["class_name"], lc["config"]
+            if i == last_real and folded_act is not None:
+                c = dict(c, activation=folded_act)
             if cls == "InputLayer":
                 shape = c.get("batch_shape") or c.get("batch_input_shape")
                 lb.set_input_type(KerasModelImport._input_type(shape))

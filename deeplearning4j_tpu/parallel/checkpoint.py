@@ -70,6 +70,10 @@ class CheckpointListener(TrainingListener):
         self.ckpt = ShardedCheckpointer(directory, keep_last=keep_last)
         self.every_iter = save_every_n_iterations
         self.every_epoch = save_every_n_epochs
+        # Last orbax step label saved by THIS listener: when an epoch
+        # boundary coincides with an every-N iteration, both hooks would
+        # target the same step and orbax raises StepAlreadyExistsError.
+        self._last_saved_step: Optional[int] = None
 
     def _state(self, model, completed_iterations=None):
         # counters.iteration stores ITERATIONS COMPLETED: listeners fire
@@ -92,6 +96,7 @@ class CheckpointListener(TrainingListener):
             # at; the stored counter = iteration + 1 (completed).
             self.ckpt.save(iteration, self._state(model, iteration + 1),
                            metrics={"loss": float(loss)})
+            self._last_saved_step = iteration
 
     def on_epoch_end(self, model, epoch):
         if self.every_epoch and (epoch + 1) % self.every_epoch == 0 \
@@ -100,7 +105,15 @@ class CheckpointListener(TrainingListener):
             # last completed iteration index, stored counter = completed
             # count (= step + 1).  Keeps the two paths from colliding on
             # one step label with different counters.
-            self.ckpt.save(model.iteration_count - 1, self._state(model))
+            step = model.iteration_count - 1
+            # Skip when this step is already checkpointed — by the
+            # iteration hook this session, or persisted on disk by a
+            # pre-preemption run (a fresh listener's in-memory marker is
+            # empty, but the orbax directory isn't).
+            if step == self._last_saved_step or step in self.ckpt.all_steps():
+                return
+            self.ckpt.save(step, self._state(model))
+            self._last_saved_step = step
 
     def restore_into(self, model):
         """Resume a model in place from the newest checkpoint; returns the

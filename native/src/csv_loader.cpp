@@ -53,6 +53,17 @@ int parse_lines(const char* p, const char* end, char delim, float* out,
     if (p >= end) break;
     if (row >= max_rows) return -5;  /* more data than the caller sized */
     for (int64_t c = 0; c < n_cols; ++c) {
+      if (c > 0) {
+        /* strtof skips leading whitespace INCLUDING '\n', so a ragged
+         * row with a trailing empty field would silently consume the
+         * next line's first value; fail deterministically instead. */
+        const char* scan = p;
+        while (scan < end && (*scan == ' ' || *scan == '\t' ||
+                              *scan == '\r' || *scan == '\v' ||
+                              *scan == '\f'))
+          ++scan;
+        if (scan >= end || *scan == '\n') return -4;
+      }
       char* next = nullptr;
       errno = 0;
       float v = std::strtof(p, &next);

@@ -114,6 +114,30 @@ static void test_undersized_buffer_rejected() {
   std::remove(p.c_str());
 }
 
+static void test_ragged_row_fails_deterministically() {
+  /* A row with a trailing empty field ("1,2," with 3 declared cols)
+   * must error -4, not let strtof skip the newline and consume the
+   * next line's first value (advisor round 2). */
+  std::string p = write_tmp("1,2,3\n4,5,\n6,7,8\n");
+  int64_t rows, cols;
+  CHECK(dl4j_csv_dims(p.c_str(), 0, ',', &rows, &cols) == 0);
+  CHECK(rows == 3 && cols == 3);
+  float out[9];
+  CHECK(dl4j_csv_parse(p.c_str(), 0, ',', out, rows, cols, 1) == -4);
+  /* same failure when the ragged line ends a thread's chunk */
+  std::string content;
+  for (int i = 0; i < 500; ++i) content += "1,2,3\n";
+  content += "4,5,\n";
+  for (int i = 0; i < 500; ++i) content += "6,7,8\n";
+  std::string p2 = write_tmp(content.c_str());
+  CHECK(dl4j_csv_dims(p2.c_str(), 0, ',', &rows, &cols) == 0);
+  std::string buf(rows * cols * 4, '\0');
+  float* o = reinterpret_cast<float*>(&buf[0]);
+  CHECK(dl4j_csv_parse(p2.c_str(), 0, ',', o, rows, cols, 4) == -4);
+  std::remove(p.c_str());
+  std::remove(p2.c_str());
+}
+
 static void test_errors() {
   std::string p = write_tmp("1,abc,3\n");
   int64_t rows, cols;
@@ -141,6 +165,7 @@ int main() {
   test_tab_lines_and_tab_delimiter();
   test_space_delimiter_trailing_blank();
   test_undersized_buffer_rejected();
+  test_ragged_row_fails_deterministically();
   test_errors();
   test_u8_scale();
   if (failures) {

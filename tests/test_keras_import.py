@@ -65,6 +65,61 @@ def test_sequential_batchnorm_running_stats(tmp_path):
                                _predict(m, x), atol=1e-5)
 
 
+def test_sequential_trailing_activation_folds_into_output(tmp_path):
+    """Dense + standalone Activation('softmax') at the end of a
+    Sequential must import as ONE OutputLayer so the network has a loss
+    head (advisor round 2) — with output parity preserved."""
+    from keras import layers
+    m = keras.Sequential([
+        keras.Input((6,)),
+        layers.Dense(12, activation="relu", name="h"),
+        layers.Dense(4, name="logits"),
+        layers.Activation("softmax", name="sm"),
+    ])
+    p = str(tmp_path / "trail.h5")
+    m.save(p)
+
+    from deeplearning4j_tpu.keras_import import KerasModelImport
+    from deeplearning4j_tpu.nn.conf.layers_core import OutputLayer
+    model = KerasModelImport.import_keras_model_and_weights(p)
+    assert isinstance(model.conf.layers[-1], OutputLayer)
+    x = np.random.default_rng(2).normal(size=(5, 6)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(model.output(x)),
+                               _predict(m, x), atol=1e-5)
+    # the fold must leave a trainable net: one fit step runs
+    y = np.eye(4, dtype=np.float32)[np.arange(5) % 4]
+    from deeplearning4j_tpu.data.dataset import DataSet
+    model.fit(DataSet(x, y))
+
+    # Dense with its OWN non-linearity followed by Activation must NOT
+    # fold (softmax(relu(Wx+b)) ≠ softmax(Wx+b)) — parity preserved.
+    m2 = keras.Sequential([
+        keras.Input((6,)),
+        layers.Dense(4, activation="relu", name="d"),
+        layers.Activation("softmax", name="sm2"),
+    ])
+    p2 = str(tmp_path / "trail2.h5")
+    m2.save(p2)
+    model2 = KerasModelImport.import_keras_model_and_weights(p2)
+    assert not isinstance(model2.conf.layers[-2], OutputLayer)
+    np.testing.assert_allclose(np.asarray(model2.output(x)),
+                               _predict(m2, x), atol=1e-5)
+
+    # Dropout between Dense and Activation changes training numerics —
+    # no fold, and inference parity preserved (dropout = identity).
+    m3 = keras.Sequential([
+        keras.Input((6,)),
+        layers.Dense(4, name="d3"),
+        layers.Dropout(0.5),
+        layers.Activation("softmax", name="sm3"),
+    ])
+    p3 = str(tmp_path / "trail3.h5")
+    m3.save(p3)
+    model3 = KerasModelImport.import_keras_model_and_weights(p3)
+    np.testing.assert_allclose(np.asarray(model3.output(x)),
+                               _predict(m3, x), atol=1e-5)
+
+
 def test_sequential_lstm_parity(tmp_path):
     from keras import layers
     m = keras.Sequential([

@@ -119,6 +119,28 @@ def test_checkpoint_listener_resume(tmp_path, rng):
     fresh.fit(ds)
 
 
+def test_checkpoint_iter_epoch_same_step_no_collision(tmp_path, rng):
+    """When an epoch boundary lands on an every-N iteration (e.g.
+    every_iter=5 with 6 iters/epoch) both hooks target orbax step 5;
+    the epoch hook must skip instead of raising StepAlreadyExistsError
+    (advisor round 2)."""
+    model = _model()
+    x, y = _data(rng)
+    model.fit(DataSet(x, y))  # materialize params/opt state
+    lst = CheckpointListener(tmp_path / "col", save_every_n_iterations=5,
+                             save_every_n_epochs=1)
+    model.iteration_count = 6          # 6 iterations completed
+    lst.iteration_done(model, 5, 0, 0.5)   # every-N hook: saves step 5
+    lst.on_epoch_end(model, 0)             # epoch hook: same step — skip
+    lst.ckpt.wait()
+    assert lst.ckpt.all_steps() == [5]
+    # a later epoch end on a NON-colliding step still saves
+    model.iteration_count = 9
+    lst.on_epoch_end(model, 1)
+    lst.ckpt.wait()
+    assert lst.ckpt.all_steps() == [5, 8]
+
+
 # ---------------------------------------------------------------------------
 # Distributed helpers (single-process loopback, 8 virtual devices)
 # ---------------------------------------------------------------------------
