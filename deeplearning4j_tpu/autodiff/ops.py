@@ -445,6 +445,33 @@ def _max_pool(x, ksize=(2, 2), strides=(2, 2), padding="VALID"):
                              padding)
 
 
+@register_op("fused_attention")
+def _fused_attention(q, k, v, bias=None, causal=False, scale=None,
+                     compute_dtype=None):
+    """softmax(QK^T*scale + bias)V in one node — the lowering target of
+    the importer's attention-subgraph rewrite (``autodiff/rewrites.py``).
+    Routes to the Pallas flash kernel when shape/mask permit, else to
+    XLA einsums.  ``compute_dtype='bfloat16'`` runs the attention math
+    at full MXU rate (the TPU training configuration); output returns
+    in the input dtype either way."""
+    from deeplearning4j_tpu.kernels.flash_attention import attention
+    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    out_dtype = q.dtype
+    if compute_dtype is not None:
+        cd = jnp.dtype(compute_dtype)
+        q, k, v = q.astype(cd), k.astype(cd), v.astype(cd)
+    squeeze_head = q.ndim == 3
+    if squeeze_head:   # [b, t, d] -> single-head [b, 1, t, d]
+        q, k, v = q[:, None], k[:, None], v[:, None]
+    out = attention(q, k, v,
+                    bias=None if bias is None else jnp.asarray(bias),
+                    causal=bool(causal),
+                    scale=None if scale is None else float(scale))
+    if squeeze_head:
+        out = out[:, 0]
+    return out.astype(out_dtype)
+
+
 @register_op("avg_pool")
 def _avg_pool(x, ksize=(2, 2), strides=(2, 2), padding="VALID"):
     k, s = tuple(int(v) for v in ksize), tuple(int(v) for v in strides)

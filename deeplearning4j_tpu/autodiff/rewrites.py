@@ -1,0 +1,199 @@
+"""Graph-IR rewrite passes.
+
+The TPU analogue of the reference's platform-helper dispatch
+(``libnd4j/include/ops/declarable/platform/cudnn/**`` shadowing generic
+op math at execution time ``[UNVERIFIED]``): instead of a per-call
+helper seam, we rewrite the imported graph ONCE — a
+``matmul(transpose_b) → [scale] → [+bias] → softmax → matmul``
+chain collapses into a single ``fused_attention`` node, which lowers to
+the Pallas flash-attention kernel (O(t) memory, blocks on the MXU).
+This is what connects a TF-imported BERT encoder to the hand kernel:
+after ``fuse_attention(sd)`` the fine-tune path executes flash
+attention instead of materializing [t, t] score matrices.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import OpNode, SameDiff
+
+# Ops that may sit between the softmax and the PV matmul without
+# changing inference semantics (imported dropout freezes to identity).
+# NOT stop_gradient — removing it would change gradients.
+_PASSTHROUGH = ("identity", "dropout")
+
+
+def _scalar_const(sd: SameDiff, name: str) -> Optional[float]:
+    """Host value of `name` when it is a scalar CONSTANT, else None."""
+    var = sd.vars.get(name)
+    if var is None or var.var_type != "CONSTANT":
+        return None
+    val = np.asarray(sd.values.get(name))
+    if val.size != 1:
+        return None
+    return float(val.reshape(()))
+
+
+class _Maps:
+    def __init__(self, sd: SameDiff):
+        self.produced_by: Dict[str, int] = {
+            o: i for i, n in enumerate(sd.ops) for o in n.outputs}
+        self.consumers: Dict[str, List[int]] = {}
+        for i, n in enumerate(sd.ops):
+            for inp in n.inputs:
+                self.consumers.setdefault(inp, []).append(i)
+        consumed = set(self.consumers)
+        self.graph_outputs = {o for n in sd.ops for o in n.outputs
+                              if o not in consumed}
+
+
+def _single_consumer(maps: _Maps, sd: SameDiff, name: str) -> bool:
+    return (len(maps.consumers.get(name, [])) == 1
+            and name not in maps.graph_outputs
+            and name not in sd.loss_variables)
+
+
+def _match_scores(sd: SameDiff, maps: _Maps, cur: str, allow_bias: bool,
+                  depth: int = 0
+                  ) -> Optional[Tuple[str, str, Optional[float],
+                                      Optional[str], List[int]]]:
+    """Match ``cur`` (the softmax input) as
+    ``[+scalar]* [+bias]? [*scale]* matmul(q, k, transpose_b=True)``.
+
+    Scalar-constant adds are softmax-invariant and dropped.  A tensor
+    add (the additive padding mask) is only legal ABOVE all scales —
+    below a scale the fused formula ``softmax(qk*scale + bias)`` would
+    mis-scale it.  Returns (q, k, scale, bias, chain_op_indices)."""
+    if depth > 8:
+        return None
+    pi = maps.produced_by.get(cur)
+    if pi is None or not _single_consumer(maps, sd, cur):
+        return None
+    p = sd.ops[pi]
+    if p.op_name == "matmul":
+        if p.attrs.get("transpose_a", False) or \
+                not p.attrs.get("transpose_b", False):
+            return None
+        return p.inputs[0], p.inputs[1], None, None, [pi]
+    if p.op_name in ("mul", "div"):
+        c = _scalar_const(sd, p.inputs[1])
+        side = p.inputs[0]
+        if c is None:
+            if p.op_name == "div":
+                return None          # div by tensor: not a scale
+            c = _scalar_const(sd, p.inputs[0])
+            side = p.inputs[1]
+            if c is None:
+                return None
+        f = (1.0 / c) if p.op_name == "div" else c
+        sub = _match_scores(sd, maps, side, False, depth + 1)
+        if sub is None:
+            return None
+        q, k, scale, bias, chain = sub
+        scale = f if scale is None else scale * f
+        return q, k, scale, bias, chain + [pi]
+    if p.op_name == "add":
+        c0 = _scalar_const(sd, p.inputs[0])
+        c1 = _scalar_const(sd, p.inputs[1])
+        if c0 is not None or c1 is not None:
+            cont = p.inputs[1] if c0 is not None else p.inputs[0]
+            sub = _match_scores(sd, maps, cont, allow_bias, depth + 1)
+            if sub is None:
+                return None
+            q, k, scale, bias, chain = sub
+            return q, k, scale, bias, chain + [pi]
+        if not allow_bias:
+            return None
+        matches = []
+        for cont, bias_side in ((p.inputs[0], p.inputs[1]),
+                                (p.inputs[1], p.inputs[0])):
+            sub = _match_scores(sd, maps, cont, False, depth + 1)
+            if sub is not None:
+                matches.append((sub, bias_side))
+        if len(matches) != 1:        # no match, or ambiguous: skip
+            return None
+        (q, k, scale, _, chain), bias = matches[0]
+        return q, k, scale, bias, chain + [pi]
+    return None
+
+
+def _match_pv(sd: SameDiff, maps: _Maps, sm_out: str
+              ) -> Optional[Tuple[int, List[int]]]:
+    """Follow single-consumer identity/dropout from the softmax output
+    to a ``matmul(probs, v)``.  Returns (matmul_idx, passthrough_idxs)."""
+    drop: List[int] = []
+    cur = sm_out
+    for _ in range(4):
+        cons = maps.consumers.get(cur, [])
+        if len(cons) != 1 or not _single_consumer(maps, sd, cur):
+            return None
+        n = sd.ops[cons[0]]
+        if n.op_name in _PASSTHROUGH:
+            drop.append(cons[0])
+            cur = n.outputs[0]
+            continue
+        if n.op_name == "matmul" and n.inputs[0] == cur and \
+                not n.attrs.get("transpose_a", False) and \
+                not n.attrs.get("transpose_b", False):
+            return cons[0], drop
+        return None
+    return None
+
+
+def fuse_attention(sd: SameDiff, compute_dtype: Optional[str] = None
+                   ) -> int:
+    """Rewrite attention subgraphs into ``fused_attention`` nodes.
+
+    Every intermediate must have exactly one consumer (so the rewrite
+    cannot orphan a fetched tensor); the q/k/v/bias inputs themselves
+    may fan out freely (BERT shares the mask bias across layers).
+
+    ``compute_dtype='bfloat16'`` makes the fused node run its matmuls
+    at full MXU rate (the training configuration); None preserves
+    import numerics exactly (parity tests).  Returns the number of
+    attention sites fused."""
+    total = 0
+    while True:                      # re-derive maps after each fusion
+        maps = _Maps(sd)
+        match = None
+        for si, node in enumerate(sd.ops):
+            if node.op_name != "softmax" or \
+                    int(node.attrs.get("axis", -1)) != -1:
+                continue
+            pv = _match_pv(sd, maps, node.outputs[0])
+            if pv is None:
+                continue
+            mi, passthrough = pv
+            scores = _match_scores(sd, maps, node.inputs[0], True)
+            if scores is None:
+                continue
+            q, k, scale, bias, chain = scores
+            match = (si, mi, passthrough, q, k, sd.ops[mi].inputs[1],
+                     bias, scale, chain)
+            break
+        if match is None:
+            return total
+        si, mi, passthrough, q, k, v, bias, scale, chain = match
+        drop = set(chain) | set(passthrough) | {si, mi}
+        inputs = [q, k, v] + ([bias] if bias is not None else [])
+        fused = OpNode("fused_attention", inputs,
+                       [sd.ops[mi].outputs[0]],
+                       {"causal": False,
+                        "scale": 1.0 if scale is None else float(scale),
+                        "compute_dtype": compute_dtype})
+        new_ops: List[OpNode] = []
+        for i, n in enumerate(sd.ops):
+            if i == mi:
+                new_ops.append(fused)
+            elif i not in drop:
+                new_ops.append(n)
+        keep_out = fused.outputs[0]
+        for i in drop:                # orphaned intermediate ARRAY vars
+            for o in sd.ops[i].outputs:
+                if o != keep_out:
+                    sd.vars.pop(o, None)
+        sd.ops = new_ops
+        sd._fn_cache.clear()
+        total += 1

@@ -3,6 +3,8 @@ fusion leaves throughput on the table — the role the reference filled
 with hand-optimized CUDA helpers (``libnd4j/.../helpers/cuda``), except
 each kernel here is a few dozen lines of Python lowered through Mosaic.
 """
-from deeplearning4j_tpu.kernels.flash_attention import flash_attention
+from deeplearning4j_tpu.kernels.flash_attention import (
+    attention, flash_attention, mask_to_bias, xla_attention)
 
-__all__ = ["flash_attention"]
+__all__ = ["attention", "flash_attention", "mask_to_bias",
+           "xla_attention"]

@@ -304,27 +304,20 @@ class SelfAttentionLayer(BaseLayerConf):
         b, t, _ = q.shape
         split = lambda z: z.reshape(b, -1, h, s).transpose(0, 2, 1, 3)
         q, k, v = split(q), split(k), split(v)
-        t_len = q.shape[2]
-        # Flash path only when a Mosaic-legal tiling exists: blk == t
-        # (blocks equal the array dims) for t <= 512 with sublane-
-        # aligned t, else blk = 512 when 512 tiles t.  Anything else
-        # falls back to the einsum path rather than shipping a block
-        # shape the TPU lowering rejects (or a degenerate tiny block).
-        if self.use_flash and mask is None and q.shape[2] == k.shape[2]:
-            blk = None
-            if t_len <= 512 and t_len % 8 == 0:
-                blk = t_len
-            elif t_len % 512 == 0:
-                blk = 512
-            if blk is not None:
-                from deeplearning4j_tpu.kernels import flash_attention
-                if jax.default_backend() == "tpu" and q.dtype == jnp.float32:
-                    # f32 operands run the MXU at 1/8 rate (see the
-                    # kernel header): use_flash on TPU implies bf16
-                    # attention math, the TPU-native training choice.
-                    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
-                out = flash_attention(q, k, v, blk, blk)
-                return out.transpose(0, 2, 1, 3).reshape(b, -1, h * s)
+        # The fused-attention entry routes to the Pallas flash kernel
+        # when the shape permits (auto-tuned blocks) and falls back to
+        # the XLA einsum path otherwise; a [b, t] sequence mask becomes
+        # the kernel's additive key-position bias.
+        if self.use_flash and q.shape[2] == k.shape[2]:
+            from deeplearning4j_tpu.kernels import attention, mask_to_bias
+            bias = mask_to_bias(mask)
+            if jax.default_backend() == "tpu" and q.dtype == jnp.float32:
+                # f32 operands run the MXU at 1/8 rate (see the
+                # kernel header): use_flash on TPU implies bf16
+                # attention math, the TPU-native training choice.
+                q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+            out = attention(q, k, v, bias=bias)
+            return out.transpose(0, 2, 1, 3).reshape(b, -1, h * s)
         logits = jnp.einsum("bhqs,bhks->bhqk", q, k) / jnp.sqrt(
             jnp.asarray(s, q.dtype))
         if mask is not None:

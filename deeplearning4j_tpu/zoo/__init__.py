@@ -15,10 +15,11 @@ from deeplearning4j_tpu.zoo.text_generation_lstm import TextGenerationLSTM
 from deeplearning4j_tpu.zoo.unet import UNet
 from deeplearning4j_tpu.zoo.inception import InceptionResNetV1
 from deeplearning4j_tpu.zoo.darknet import Darknet19, TinyYOLO, Yolo2OutputLayer
+from deeplearning4j_tpu.zoo.bert import Bert
 from deeplearning4j_tpu.zoo.pretrained import (load_pretrained, register,
                                                save_pretrained)
 
 __all__ = ["ZooModel", "LeNet", "AlexNet", "VGG16", "VGG19", "ResNet50",
            "SimpleCNN", "TextGenerationLSTM", "UNet", "InceptionResNetV1",
-           "Darknet19", "TinyYOLO", "Yolo2OutputLayer",
+           "Darknet19", "TinyYOLO", "Yolo2OutputLayer", "Bert",
            "save_pretrained", "load_pretrained", "register"]

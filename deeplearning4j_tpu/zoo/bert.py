@@ -1,0 +1,71 @@
+"""BERT encoder classifier — the transformer flagship.
+
+The reference has no zoo BERT builder (its BERT path is TF import,
+BASELINE config 4); this is the framework-native equivalent, the model
+the transformer training benchmark (`bench.py`) runs.  Defaults are
+BERT-base (12 x 768, 12 heads, ff 3072, vocab 30522).  The encoder
+stack is `EmbeddingSequenceLayer` + N x `TransformerEncoderBlock`
+(Pallas flash attention in the hot path) + masked mean-pool + softmax
+head, compiled to a single XLA program with bf16 matmuls.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers_conv import GlobalPoolingLayer
+from deeplearning4j_tpu.nn.conf.layers_core import OutputLayer
+from deeplearning4j_tpu.nn.conf.layers_transformer import (
+    EmbeddingSequenceLayer, TransformerEncoderBlock)
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+@dataclasses.dataclass
+class Bert(ZooModel):
+    """BERT-shaped encoder classifier.  ``Bert()`` is BERT-base;
+    shrink n_layers/d_model for tests."""
+
+    n_classes: int = 2
+    vocab_size: int = 30522
+    max_len: int = 512
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dropout: float = 0.0
+    seq_len: int = 128            # training sequence length
+    compute_dtype: Optional[str] = "bfloat16"
+    use_flash: bool = True
+    updater: object = None
+
+    def conf(self):
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(learning_rate=2e-5)))
+        if self.compute_dtype:
+            b = b.compute_dtype(self.compute_dtype)
+        lst = (b.list()
+               .set_input_type(InputType.feed_forward(self.seq_len))
+               .layer(EmbeddingSequenceLayer(
+                   n_in=self.vocab_size, n_out=self.d_model,
+                   max_len=self.max_len, dropout=self.dropout or None)))
+        for _ in range(self.n_layers):
+            lst = lst.layer(TransformerEncoderBlock(
+                n_heads=self.n_heads, d_ff=self.d_ff,
+                dropout=self.dropout or None, use_flash=self.use_flash))
+        return (lst
+                .layer(GlobalPoolingLayer(pooling_type="avg"))
+                .layer(OutputLayer(n_out=self.n_classes,
+                                   activation="softmax", loss="mcxent"))
+                .build())
+
+    def flops_per_token_train(self) -> float:
+        """Analytic fwd+bwd FLOPs/token for MFU accounting: 6 FLOPs per
+        matmul parameter (2 fwd + 4 bwd) plus the attention
+        score/context matmuls (4*t*d/token/layer fwd, x3 for train)."""
+        d, ff, L, t = self.d_model, self.d_ff, self.n_layers, self.seq_len
+        matmul_params = L * (4 * d * d + 2 * d * ff)
+        return 6.0 * matmul_params + 12.0 * L * t * d
