@@ -445,6 +445,23 @@ def _max_pool(x, ksize=(2, 2), strides=(2, 2), padding="VALID"):
                              padding)
 
 
+# ---------------------------------------------------------------------------
+# Control flow — registered for build-time lookup; EXECUTION is handled
+# by SameDiff._run_graph (_exec_while/_exec_cond lowering to jax.lax),
+# because these ops carry whole subgraphs in their attrs.
+# ---------------------------------------------------------------------------
+@register_op("while_loop", n_out=0)
+def _while_loop_stub(*args, **attrs):
+    raise RuntimeError(
+        "while_loop executes via SameDiff._exec_while, not the registry")
+
+
+@register_op("cond", n_out=0)
+def _cond_stub(*args, **attrs):
+    raise RuntimeError(
+        "cond executes via SameDiff._exec_cond, not the registry")
+
+
 @register_op("fused_attention")
 def _fused_attention(q, k, v, bias=None, causal=False, scale=None,
                      compute_dtype=None):

@@ -36,7 +36,10 @@ VAR_TYPES = ("VARIABLE", "CONSTANT", "PLACEHOLDER", "ARRAY")
 
 
 def _clean_attr(v):
-    """JSON-safe attrs (TF import hands us np arrays/bytes/dtypes)."""
+    """JSON-safe attrs (TF import hands us np arrays/bytes/dtypes;
+    control-flow ops carry whole subgraphs)."""
+    if isinstance(v, SameDiff):
+        return {"__subgraph__": v.to_portable_dict()}
     if isinstance(v, bytes):
         return v.decode()
     if isinstance(v, (np.ndarray, np.generic)):
@@ -45,6 +48,13 @@ def _clean_attr(v):
         return [_clean_attr(x) for x in v]
     if isinstance(v, np.dtype):
         return v.name
+    return v
+
+
+def _revive_attr(v):
+    """Inverse of _clean_attr for the subgraph case."""
+    if isinstance(v, dict) and "__subgraph__" in v:
+        return SameDiff.from_portable_dict(v["__subgraph__"])
     return v
 
 
@@ -134,6 +144,8 @@ class SameDiff:
         self.ops: List[OpNode] = []  # creation order == topological order
         self.loss_variables: List[str] = []
         self.training_config: Optional[TrainingConfig] = None
+        # designated outputs (subgraphs need an explicit, ordered list)
+        self.outputs: Optional[List[str]] = None
         self._updater_state = None
         self._step = 0
         self._fn_cache: Dict[Any, Any] = {}
@@ -241,15 +253,80 @@ class SameDiff:
         for node in self.ops:
             if not any(o in needed for o in node.outputs):
                 continue
-            op = get_op(node.op_name)
             args = [env[i] for i in node.inputs]
-            out = op.fn(*args, **node.attrs)
+            if node.op_name == "while_loop":
+                out = self._exec_while(node, args)
+            elif node.op_name == "cond":
+                out = self._exec_cond(node, args)
+            else:
+                op = get_op(node.op_name)
+                out = op.fn(*args, **node.attrs)
             if len(node.outputs) == 1:
                 env[node.outputs[0]] = out
             else:
                 for o, v in zip(node.outputs, out):
                     env[o] = v
         return env
+
+    # ------------------------------------------------------------------
+    # Control flow (SURVEY §3.3: the TF Switch/Merge/Enter/Exit frame
+    # machinery of AbstractSession becomes structured lax.while_loop /
+    # lax.cond — compiler-friendly, no per-op frame interpreter)
+    # ------------------------------------------------------------------
+    def run_subgraph(self, inputs: Sequence[Any]) -> List[Any]:
+        """Execute this graph as a PURE function: `inputs` bind to the
+        placeholders in registration order; returns the designated
+        ``self.outputs`` (explicit, ordered — required for subgraphs)."""
+        ph = [v.name for v in self.vars.values()
+              if v.var_type == "PLACEHOLDER"]
+        if len(ph) != len(inputs):
+            raise ValueError(
+                f"subgraph expects {len(ph)} inputs ({ph}), got "
+                f"{len(inputs)}")
+        outs = self.outputs
+        if not outs:
+            raise ValueError("subgraph has no designated outputs")
+        needed = self._needed_for(outs)
+        env = self._run_graph(self._param_values(),
+                              dict(zip(ph, inputs)), needed)
+        return [env[o] for o in outs]
+
+    def _exec_while(self, node, args):
+        """``while cond(*state): state = body(*state)`` via
+        lax.while_loop.  State is ALL inputs (TF v2 While semantics:
+        captured tensors ride as pass-through loop vars).  Inference
+        only — XLA while is not reverse-differentiable; training
+        through a loop needs a scan-convertible bound."""
+        cond_sd, body_sd = node.attrs["cond"], node.attrs["body"]
+        init = tuple(jnp.asarray(a) for a in args)
+
+        def cond_fn(state):
+            r = cond_sd.run_subgraph(list(state))
+            return jnp.reshape(jnp.asarray(r[0]), ()).astype(bool)
+
+        def body_fn(state):
+            r = body_sd.run_subgraph(list(state))
+            return tuple(jnp.asarray(x).astype(i.dtype)
+                         for x, i in zip(r, init))
+
+        out = jax.lax.while_loop(cond_fn, body_fn, init)
+        return out if len(node.outputs) > 1 else out[0]
+
+    def _exec_cond(self, node, args):
+        """``then(*operands) if pred else orelse(*operands)`` via
+        lax.cond (differentiable)."""
+        then_sd, else_sd = node.attrs["then"], node.attrs["orelse"]
+        pred = jnp.reshape(jnp.asarray(args[0]).astype(bool), ())
+        operands = tuple(jnp.asarray(a) for a in args[1:])
+
+        def mk(branch_sd):
+            def fn(ops_):
+                r = branch_sd.run_subgraph(list(ops_))
+                return tuple(jnp.asarray(x) for x in r)
+            return fn
+
+        out = jax.lax.cond(pred, mk(then_sd), mk(else_sd), operands)
+        return out if len(node.outputs) > 1 else out[0]
 
     def _needed_for(self, outputs: Sequence[str]) -> set:
         """Backward slice: op outputs required to compute `outputs`."""
@@ -355,6 +432,7 @@ class SameDiff:
                                                 step_idx)
             params = jax.tree_util.tree_map(lambda p, u: p - u, params,
                                             updates)
+            opt_state = updater.finalize(opt_state, params)
             return params, opt_state, loss
 
         return jax.jit(step, donate_argnums=(0, 1)), updater
@@ -406,7 +484,35 @@ class SameDiff:
                 for v in self.vars.values()],
             "ops": [n.to_dict() for n in self.ops],
             "loss_variables": self.loss_variables,
+            "outputs": self.outputs,
         }
+
+    def to_portable_dict(self) -> dict:
+        """Self-contained dict INCLUDING values inline (JSON-safe) —
+        how control-flow subgraphs embed in their parent's attrs."""
+        d = self.to_dict()
+        d["values_inline"] = {
+            k: {"dtype": str(np.asarray(v).dtype),
+                "shape": list(np.asarray(v).shape),
+                "data": np.asarray(v).reshape(-1).tolist()}
+            for k, v in self.values.items()}
+        return d
+
+    @staticmethod
+    def from_portable_dict(d: dict) -> "SameDiff":
+        sd = SameDiff()
+        for v in d["variables"]:
+            sd._register(v["name"], v["type"], v["shape"], v["dtype"])
+        for n in d["ops"]:
+            sd.ops.append(OpNode(
+                n["op"], n["inputs"], n["outputs"],
+                {k: _revive_attr(v) for k, v in n["attrs"].items()}))
+        sd.loss_variables = d.get("loss_variables", [])
+        sd.outputs = d.get("outputs")
+        for k, meta in d.get("values_inline", {}).items():
+            sd.values[k] = np.asarray(
+                meta["data"], dtype=meta["dtype"]).reshape(meta["shape"])
+        return sd
 
     def save(self, path: str):
         buf = io.BytesIO()
@@ -424,9 +530,11 @@ class SameDiff:
             for v in d["variables"]:
                 sd._register(v["name"], v["type"], v["shape"], v["dtype"])
             for n in d["ops"]:
-                sd.ops.append(OpNode(n["op"], n["inputs"], n["outputs"],
-                                     n["attrs"]))
+                sd.ops.append(OpNode(
+                    n["op"], n["inputs"], n["outputs"],
+                    {k: _revive_attr(v) for k, v in n["attrs"].items()}))
             sd.loss_variables = d.get("loss_variables", [])
+            sd.outputs = d.get("outputs")
             for k in vals.files:
                 sd.values[k] = vals[k]
         return sd

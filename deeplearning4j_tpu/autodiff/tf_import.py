@@ -104,8 +104,14 @@ def _tf_attr(node, name, default=None):
 
 class _Importer:
     def __init__(self, graph_def, trainable_consts: bool = True,
-                 trainable_filter: Optional[Callable] = None):
+                 trainable_filter: Optional[Callable] = None,
+                 library=None):
         self.gd = graph_def
+        # NESTED control flow: a FuncGraph's GraphDef has an empty
+        # library, so sub-importers inherit the ROOT graph's library to
+        # resolve inner StatelessWhile/If function names.
+        self.library = library if library is not None else \
+            graph_def.library
         self.sd = SameDiff.create()
         self.trainable_consts = trainable_consts
         self.trainable_filter = trainable_filter or _default_trainable_filter
@@ -341,11 +347,46 @@ class _Importer:
                                   perm=(0, 3, 1, 2))
             return self._emit(node, "fused_batch_norm", ins, n_out=1,
                               eps=eps)
+        if op in ("StatelessWhile", "While"):
+            cond_sd = self._import_function(node.attr["cond"].func.name)
+            body_sd = self._import_function(node.attr["body"].func.name)
+            return self._emit(node, "while_loop", ins, n_out=len(ins),
+                              cond=cond_sd, body=body_sd)
+        if op in ("StatelessIf", "If"):
+            then_sd = self._import_function(
+                node.attr["then_branch"].func.name)
+            else_sd = self._import_function(
+                node.attr["else_branch"].func.name)
+            n_out = len(node.attr["Tout"].list.type) or 1
+            return self._emit(node, "cond", ins, n_out=n_out,
+                              then=then_sd, orelse=else_sd)
         raise NotImplementedError(
             f"TF op {op!r} (node {node.name!r}) has no import mapping — "
             "register one in deeplearning4j_tpu/autodiff/tf_import.py")
 
-    def run(self) -> SameDiff:
+    def _import_function(self, fname: str):
+        """FunctionDef (from graph_def.library) → sub-SameDiff with
+        ordered placeholders and designated outputs — the body of a
+        while_loop/cond IR node.  Uses TF's own function_def_to_graph
+        so `node:out:i` function-body tensor refs resolve correctly."""
+        from tensorflow.python.framework.function_def_to_graph import (
+            function_def_to_graph)
+        fdef = next((f for f in self.library.function
+                     if f.signature.name == fname), None)
+        if fdef is None:
+            raise ValueError(f"Function {fname!r} not in graph library")
+        fg = function_def_to_graph(fdef)
+        sub = _Importer(fg.as_graph_def(), trainable_consts=False,
+                        library=self.library)
+        sub_sd = sub.run(prune=False)
+        sub_sd.outputs = []
+        for t in fg.outputs:
+            name = t.op.name if t.value_index == 0 else \
+                f"{t.op.name}:{t.value_index}"
+            sub_sd.outputs.append(name)
+        return sub_sd
+
+    def run(self, prune: bool = True) -> SameDiff:
         nodes = list(self.gd.node)
         # GraphDefs from freezing are topologically sorted, but don't rely
         # on it (Kahn over tensor deps).
@@ -369,13 +410,16 @@ class _Importer:
             self._handle(node)
         # Dead-code elimination: consts only consumed by skipped nodes
         # (Assert messages and the like — including non-numeric string
-        # tensors npz can't store) are dropped.
-        consumed = {i for n in self.sd.ops for i in n.inputs}
-        produced = {o for n in self.sd.ops for o in n.outputs}
-        for name in list(self.sd.values):
-            if name not in consumed and name not in produced:
-                del self.sd.values[name]
-                del self.sd.vars[name]
+        # tensors npz can't store) are dropped.  Subgraph imports skip
+        # this (prune=False): a function OUTPUT may legally be a raw
+        # placeholder/const no op consumes.
+        if prune:
+            consumed = {i for n in self.sd.ops for i in n.inputs}
+            produced = {o for n in self.sd.ops for o in n.outputs}
+            for name in list(self.sd.values):
+                if name not in consumed and name not in produced:
+                    del self.sd.values[name]
+                    del self.sd.vars[name]
         return self.sd
 
 
@@ -443,11 +487,15 @@ def import_saved_model(path: str, signature: str = "serving_default",
 
 def freeze_keras_model(model, input_signature) -> "Any":
     """Helper: tf.keras/``transformers`` TF model → frozen GraphDef with
-    variables folded to Const (what ``import_graph_def`` consumes)."""
+    variables folded to Const (what ``import_graph_def`` consumes).
+    Functional control flow is preserved (lower_control_flow=False) so
+    graphs with loops import as while_loop/cond IR nodes instead of
+    un-importable v1 Switch/Merge frames."""
     import tensorflow as tf
     from tensorflow.python.framework.convert_to_constants import (
         convert_variables_to_constants_v2)
     fn = tf.function(lambda *a: model(*a))
     concrete = fn.get_concrete_function(*input_signature)
-    frozen = convert_variables_to_constants_v2(concrete)
+    frozen = convert_variables_to_constants_v2(concrete,
+                                               lower_control_flow=False)
     return frozen.graph.as_graph_def(), concrete

@@ -26,6 +26,49 @@ import numpy as np
 from deeplearning4j_tpu.nlp.tokenizer import DefaultTokenizerFactory
 
 
+def build_huffman(counts: Sequence[int]):
+    """Huffman tree over word counts (word2vec.c / DL4J
+    ``useHierarchicSoftmax`` semantics): returns (points, codes, mask)
+    arrays [n, D] — per-word inner-node path, binary code, and
+    valid-depth mask, padded to the max depth D."""
+    import heapq
+    n = len(counts)
+    if n < 2:
+        raise ValueError("Huffman tree needs a vocabulary of >= 2 words")
+    heap = [(int(c), i) for i, c in enumerate(counts)]
+    heapq.heapify(heap)
+    parent: Dict[int, int] = {}
+    branch: Dict[int, int] = {}
+    nxt = n
+    while len(heap) > 1:
+        c1, a = heapq.heappop(heap)
+        c2, b = heapq.heappop(heap)
+        parent[a], branch[a] = nxt, 0
+        parent[b], branch[b] = nxt, 1
+        heapq.heappush(heap, (c1 + c2, nxt))
+        nxt += 1
+    root = heap[0][1]
+    paths, codes = [], []
+    for w in range(n):
+        p, cd, node = [], [], w
+        while node != root:
+            cd.append(branch[node])
+            node = parent[node]
+            p.append(node - n)        # inner-node id in [0, n-1)
+        paths.append(p[::-1])
+        codes.append(cd[::-1])
+    depth = max(len(p) for p in paths)
+    points = np.zeros((n, depth), np.int32)
+    code_a = np.zeros((n, depth), np.float32)
+    mask = np.zeros((n, depth), np.float32)
+    for w in range(n):
+        k = len(paths[w])
+        points[w, :k] = paths[w]
+        code_a[w, :k] = codes[w]
+        mask[w, :k] = 1.0
+    return points, code_a, mask
+
+
 @dataclasses.dataclass
 class Word2Vec:
     vector_size: int = 64
@@ -38,6 +81,10 @@ class Word2Vec:
     min_learning_rate: float = 1e-3
     seed: int = 42
     tokenizer_factory: object = None
+    # word2vec.c fidelity knobs (VERDICT r2 item 8):
+    negative_table_power: float = 0.75  # unigram^0.75 sampling; 0=uniform
+    use_hierarchic_softmax: bool = False  # Huffman-tree HS instead of NS
+    sampling: float = 0.0               # frequent-word subsample t (0=off)
 
     def __post_init__(self):
         self.tokenizer_factory = (self.tokenizer_factory
@@ -56,12 +103,26 @@ class Word2Vec:
         self.index2word = words
         self.vocab = {w: i for i, w in enumerate(words)}
 
+    def _keep_prob(self) -> Optional[np.ndarray]:
+        """word2vec.c frequent-word subsampling: keep word w with prob
+        (sqrt(f/t) + 1) * t/f where f is the corpus frequency."""
+        if not self.sampling:
+            return None
+        total = sum(self.counts[w] for w in self.index2word)
+        f = np.asarray([self.counts[w] / total for w in self.index2word])
+        keep = (np.sqrt(f / self.sampling) + 1) * self.sampling / f
+        return np.minimum(keep, 1.0)
+
     def _pairs(self, token_lists: List[List[str]], rng: np.random.Generator
                ) -> np.ndarray:
-        """All in-window (center, context) id pairs, shuffled."""
+        """All in-window (center, context) id pairs, shuffled; frequent
+        words are subsampled first when ``sampling`` is set."""
+        keep = self._keep_prob()
         out = []
         for toks in token_lists:
             ids = [self.vocab[t] for t in toks if t in self.vocab]
+            if keep is not None:
+                ids = [i for i in ids if rng.random() < keep[i]]
             for i, c in enumerate(ids):
                 lo = max(0, i - self.window_size)
                 hi = min(len(ids), i + self.window_size + 1)
@@ -73,14 +134,33 @@ class Word2Vec:
         return pairs
 
     # ------------------------------------------------------------------
+    def _unigram_cdf(self, n_vocab: int) -> Optional[jnp.ndarray]:
+        """CDF of the unigram^power negative-sampling distribution
+        (word2vec.c's table; DL4J builds the same 1e8-slot table —
+        inverse-CDF via searchsorted needs no giant table on TPU).
+        None => uniform (power == 0 or no counts available)."""
+        if not self.negative_table_power or not self.counts:
+            return None
+        c = np.asarray([self.counts[w] for w in self.index2word],
+                       np.float64) ** self.negative_table_power
+        return jnp.asarray(np.cumsum(c) / c.sum(), jnp.float32)
+
     def _make_step(self, n_vocab: int):
         neg = self.negative
+        cdf = self._unigram_cdf(n_vocab)
+
+        def sample_negatives(key, b):
+            if cdf is None:
+                return jax.random.randint(key, (b, neg), 0, n_vocab)
+            u = jax.random.uniform(key, (b, neg))
+            return jnp.clip(jnp.searchsorted(cdf, u), 0, n_vocab - 1
+                            ).astype(jnp.int32)
 
         def step(syn0, syn1, centers, contexts, lr, key):
             """One NS update on a pair batch; returns new (syn0, syn1,
             loss)."""
             b = centers.shape[0]
-            negs = jax.random.randint(key, (b, neg), 0, n_vocab)
+            negs = sample_negatives(key, b)
             v_c = syn0[centers]                      # [b, d]
             u_pos = syn1[contexts]                   # [b, d]
             u_neg = syn1[negs]                       # [b, neg, d]
@@ -109,16 +189,53 @@ class Word2Vec:
 
         return jax.jit(step, donate_argnums=(0, 1))
 
+    def _make_hs_step(self, n_vocab: int):
+        """Hierarchical-softmax step (``useHierarchicSoftmax``): the
+        context word's Huffman path replaces negative samples; syn1
+        holds the n_vocab-1 inner-node vectors."""
+        counts = [self.counts[w] for w in self.index2word]
+        points_h, codes_h, mask_h = build_huffman(counts)
+        points_a = jnp.asarray(points_h)
+        codes_a = jnp.asarray(codes_h)
+        mask_a = jnp.asarray(mask_h)
+
+        def step(syn0, syn1, centers, contexts, lr, key):
+            b = centers.shape[0]
+            pts = points_a[contexts]             # [b, D]
+            cds = codes_a[contexts]              # [b, D]
+            msk = mask_a[contexts]               # [b, D]
+            v_c = syn0[centers]                  # [b, d]
+            u = syn1[pts]                        # [b, D, d]
+            score = jnp.einsum("bd,bkd->bk", v_c, u)
+            sgn = 1.0 - 2.0 * cds                # code 0 -> +1, 1 -> -1
+            loss = -jnp.sum(
+                jax.nn.log_sigmoid(sgn * score) * msk) / b
+            # word2vec.c HS gradient: g = (sigmoid(score) - (1 - code))
+            g = (jax.nn.sigmoid(score) - (1.0 - cds)) * msk
+            d_vc = jnp.einsum("bk,bkd->bd", g, u)
+            d_u = g[..., None] * v_c[:, None, :]
+            syn0 = syn0.at[centers].add(-lr * d_vc / b)
+            syn1 = syn1.at[pts.reshape(-1)].add(
+                -lr * d_u.reshape(-1, d_u.shape[-1]) / b)
+            return syn0, syn1, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
     def _train_pairs(self, pairs_all: np.ndarray, n_vocab: int,
                      n_rows: int, rng: np.random.Generator):
-        """The shared NS-SGD loop: epochs x shuffled batches with linear
-        LR decay.  ``n_rows`` sizes syn0 (== n_vocab for Word2Vec;
-        + n_docs for ParagraphVectors).  Returns (syn0, syn1, losses)."""
+        """The shared SGD loop (NS or HS): epochs x shuffled batches
+        with linear LR decay.  ``n_rows`` sizes syn0 (== n_vocab for
+        Word2Vec; + n_docs for ParagraphVectors).  Returns (syn0, syn1,
+        losses)."""
         d = self.vector_size
         syn0 = jnp.asarray(
             (rng.random((n_rows, d)) - 0.5) / d, jnp.float32)
-        syn1 = jnp.zeros((n_vocab, d), jnp.float32)
-        step = self._make_step(n_vocab)
+        if self.use_hierarchic_softmax:
+            syn1 = jnp.zeros((max(n_vocab - 1, 1), d), jnp.float32)
+            step = self._make_hs_step(n_vocab)
+        else:
+            syn1 = jnp.zeros((n_vocab, d), jnp.float32)
+            step = self._make_step(n_vocab)
         key = jax.random.key(self.seed)
         losses: List[float] = []
         n_batches_total = max(

@@ -8,13 +8,13 @@ pure pytree transforms fused by XLA into the compiled train step.
 """
 
 from deeplearning4j_tpu.optimize.updaters import (
-    Adam, AdamW, AdaDelta, AdaGrad, AdaMax, AMSGrad, Nadam, Nesterovs, NoOp,
-    RmsProp, Sgd, updater_from_dict,
+    Adam, AdamW, AdaDelta, AdaGrad, AdaMax, AMSGrad, Ema, Nadam, Nesterovs,
+    NoOp, RmsProp, Sgd, updater_from_dict,
 )
 from deeplearning4j_tpu.optimize.schedules import schedule_from_spec
 
 __all__ = [
     "Sgd", "Adam", "AdamW", "AdaMax", "Nesterovs", "RmsProp", "AdaGrad",
-    "AdaDelta", "AMSGrad", "Nadam", "NoOp", "updater_from_dict",
+    "AdaDelta", "AMSGrad", "Nadam", "NoOp", "Ema", "updater_from_dict",
     "schedule_from_spec",
 ]

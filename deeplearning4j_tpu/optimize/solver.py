@@ -138,6 +138,7 @@ class Solver:
                 lambda u, p, wd: u + lr * wd * p, updates, params,
                 self.decay_tree)
         params = jax.tree_util.tree_map(lambda p, u: p - u, params, updates)
+        opt_state = self.updater.finalize(opt_state, params)
         return params, opt_state, new_model_state, loss
 
     def step(self, params, opt_state, model_state, step_idx, batch, rng):

@@ -1,7 +1,7 @@
 """Updaters (optimizers) as pure pytree transforms.
 
 Parity with ND4J's updater zoo (reference: ``org.nd4j.linalg.learning.config.
-{Sgd,Adam,AdamW,AdaMax,Nesterovs,RmsProp,AdaGrad,AdaDelta,AMSGrad,Nadam,NoOp}``
+{Sgd,Adam,AdamW,AdaMax,Nesterovs,RmsProp,AdaGrad,AdaDelta,AMSGrad,Nadam,NoOp,Ema}``
 with math in ``org.nd4j.linalg.learning.{Adam,Nesterovs,...}Updater``).
 
 DL4J semantics kept for loss-curve parity:
@@ -77,6 +77,12 @@ class BaseUpdater:
 
     def update(self, grads, state, params, step):
         raise NotImplementedError
+
+    def finalize(self, state, new_params):
+        """Hook called by the trainers AFTER the final parameters are
+        computed (i.e. after decoupled weight decay is folded in) —
+        lets state transforms like Ema track the ACTUAL new params."""
+        return state
 
 
 @register_updater
@@ -251,3 +257,61 @@ class AdaDelta(BaseUpdater):
                    grads, g2, state["dx2"])
         dx2 = _tmap(lambda d, x: rho * d + (1 - rho) * x * x, state["dx2"], dx)
         return dx, {"g2": g2, "dx2": dx2}
+
+
+@register_updater
+@dataclasses.dataclass
+class Ema(BaseUpdater):
+    """Wrapper updater maintaining an exponential moving average of the
+    PARAMETERS inside the optimizer state — the TPU-native form of the
+    reference's model-averaging semantic
+    (``ParameterAveragingTrainingMaster`` averages replicas/time
+    [UNVERIFIED]; here replicas are already exact via GSPMD all-reduce,
+    so the useful axis is time: Polyak/EMA averaging).
+
+    Wraps ANY base updater, so it works unchanged from both trainers
+    (MultiLayerNetwork/ComputationGraph solver and ShardedTrainer).
+    Fetch the averaged weights with ``Ema.params_from_state(opt_state)``
+    (e.g. for eval/checkpoint); ``decay=0`` degenerates to tracking the
+    raw parameters.
+    """
+
+    base: Any = None        # BaseUpdater | serialized dict | None=Sgd
+    decay: float = 0.999
+
+    def _resolved(self) -> "BaseUpdater":
+        return updater_from_dict(self.base)
+
+    def to_dict(self):
+        d = super().to_dict()
+        if isinstance(d.get("base"), BaseUpdater):
+            d["base"] = d["base"].to_dict()
+        return d
+
+    def lr_at(self, step):
+        return self._resolved().lr_at(step)
+
+    def init_state(self, params):
+        # jnp.copy, NOT asarray: the solver donates params and
+        # opt_state separately — aliased buffers would double-donate.
+        return {"base": self._resolved().init_state(params),
+                "ema": _tmap(jnp.copy, params)}
+
+    def update(self, grads, state, params, step):
+        updates, base_state = self._resolved().update(
+            grads, state["base"], params, step)
+        # the EMA itself advances in finalize(), AFTER the trainer has
+        # folded decoupled weight decay into the updates — tracking
+        # (params - updates) here would drift by lr*wd*p per step
+        return updates, {"base": base_state, "ema": state["ema"]}
+
+    def finalize(self, state, new_params):
+        d = self.decay
+        ema = _tmap(lambda e, p: d * e + (1 - d) * p,
+                    state["ema"], new_params)
+        return {"base": state["base"], "ema": ema}
+
+    @staticmethod
+    def params_from_state(opt_state):
+        """The averaged parameter pytree held in the optimizer state."""
+        return opt_state["ema"]

@@ -23,6 +23,14 @@ def cache_dir() -> str:
                           os.path.expanduser("~/.deeplearning4j_tpu"))
 
 
+def package_weights_dir() -> str:
+    """Weight sets PUBLISHED IN-REPO (``zoo/weights/`` — the stand-in
+    for upstream's blob-hosted pretrained URL table, trained by
+    ``scripts/train_pretrained.py``)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "weights")
+
+
 def sha256_of(path: str) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:
@@ -40,14 +48,17 @@ def registered() -> Dict[Tuple[str, str], Dict[str, str]]:
 
 
 def save_pretrained(model, model_name: str, dataset: str,
-                    directory: Optional[str] = None) -> Dict[str, str]:
+                    directory: Optional[str] = None,
+                    save_updater: bool = False) -> Dict[str, str]:
     """Serialize a trained model as a registered pretrained weight set;
-    returns the registry entry (path + sha256)."""
+    returns the registry entry (path + sha256).  Updater state is
+    dropped by default — a pretrained set ships weights, not Adam
+    moments (keeps published zips ~3x smaller)."""
     from deeplearning4j_tpu.utils.model_serializer import write_model
     d = directory or cache_dir()
     os.makedirs(d, exist_ok=True)
     path = os.path.join(d, f"{model_name}_{dataset}.zip")
-    write_model(model, path)
+    write_model(model, path, save_updater=save_updater)
     digest = sha256_of(path)
     register(model_name, dataset, path, digest)
     # sidecar manifest so a fresh process can re-register without code
@@ -64,25 +75,30 @@ def load_pretrained(model_name: str, dataset: str,
     process rediscovers entries from the sidecar manifest in
     ``directory`` (default: the cache dir — pass the same directory you
     gave ``save_pretrained``)."""
-    entry = _REGISTRY.get((model_name, dataset))
+    # an explicit directory always wins over the in-process registry
+    entry = None if directory else _REGISTRY.get((model_name, dataset))
     if entry is None:
-        manifest = os.path.join(directory or cache_dir(),
-                                f"{model_name}_{dataset}.zip.json")
-        if os.path.exists(manifest):
-            with open(manifest) as f:
-                m = json.load(f)
-            # the zip sits NEXT TO its manifest: derive the path from
-            # the manifest location so a published/copied weight
-            # directory keeps working (the recorded absolute path went
-            # stale the moment the directory moved)
-            entry = {"path": manifest[: -len(".json")],
-                     "sha256": m["sha256"]}
-            _REGISTRY[(model_name, dataset)] = entry
+        search = ([directory] if directory else
+                  [cache_dir(), package_weights_dir()])
+        for d in search:
+            manifest = os.path.join(d, f"{model_name}_{dataset}.zip.json")
+            if os.path.exists(manifest):
+                with open(manifest) as f:
+                    m = json.load(f)
+                # the zip sits NEXT TO its manifest: derive the path
+                # from the manifest location so a published/copied
+                # weight directory keeps working (a recorded absolute
+                # path goes stale the moment the directory moves)
+                entry = {"path": manifest[: -len(".json")],
+                         "sha256": m["sha256"]}
+                if not directory:   # don't poison the default cache
+                    _REGISTRY[(model_name, dataset)] = entry
+                break
         else:
             raise KeyError(
                 f"No pretrained weights registered for "
                 f"({model_name!r}, {dataset!r}); have "
-                f"{sorted(_REGISTRY)}")
+                f"{sorted(_REGISTRY)} plus manifests in {search}")
     actual = sha256_of(entry["path"])
     if actual != entry["sha256"]:
         raise IOError(
