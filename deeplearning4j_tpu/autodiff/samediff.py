@@ -488,18 +488,23 @@ class SameDiff:
         }
 
     def to_portable_dict(self) -> dict:
-        """Self-contained dict INCLUDING values inline (JSON-safe) —
-        how control-flow subgraphs embed in their parent's attrs."""
+        """Self-contained dict INCLUDING values (JSON-safe) — how
+        control-flow subgraphs embed in their parent's attrs.  Values
+        ride as base64 npz bytes, not number lists: an imported loop
+        body can capture weight-sized constants, and tolist() would
+        blow the checkpoint JSON up ~10x per float."""
+        import base64
         d = self.to_dict()
-        d["values_inline"] = {
-            k: {"dtype": str(np.asarray(v).dtype),
-                "shape": list(np.asarray(v).shape),
-                "data": np.asarray(v).reshape(-1).tolist()}
-            for k, v in self.values.items()}
+        if self.values:
+            buf = io.BytesIO()
+            np.savez_compressed(buf, **self.values)
+            d["values_npz_b64"] = base64.b64encode(
+                buf.getvalue()).decode("ascii")
         return d
 
     @staticmethod
     def from_portable_dict(d: dict) -> "SameDiff":
+        import base64
         sd = SameDiff()
         for v in d["variables"]:
             sd._register(v["name"], v["type"], v["shape"], v["dtype"])
@@ -509,7 +514,12 @@ class SameDiff:
                 {k: _revive_attr(v) for k, v in n["attrs"].items()}))
         sd.loss_variables = d.get("loss_variables", [])
         sd.outputs = d.get("outputs")
-        for k, meta in d.get("values_inline", {}).items():
+        if "values_npz_b64" in d:
+            vals = np.load(io.BytesIO(
+                base64.b64decode(d["values_npz_b64"])), allow_pickle=False)
+            for k in vals.files:
+                sd.values[k] = vals[k]
+        for k, meta in d.get("values_inline", {}).items():  # legacy form
             sd.values[k] = np.asarray(
                 meta["data"], dtype=meta["dtype"]).reshape(meta["shape"])
         return sd

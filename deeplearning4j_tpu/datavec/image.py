@@ -35,6 +35,12 @@ def _decode(path: str, h: int, w: int, channels: int) -> np.ndarray:
     return img
 
 
+def _decode_f32(args):
+    """Module-level (picklable) worker for the process pool."""
+    path, h, w, c = args
+    return _decode(path, h, w, c).astype(np.float32)
+
+
 class ImageRecordReader(RecordReader):
     """Yields ``[image_hwc_float32, label_index]`` records from a
     directory tree ``root/<label>/<file>`` (ParentPathLabelGenerator) or
@@ -45,8 +51,14 @@ class ImageRecordReader(RecordReader):
                  paths: Optional[Sequence[str]] = None,
                  labels: Optional[Sequence[int]] = None,
                  label_names: Optional[List[str]] = None,
-                 shuffle_seed: Optional[int] = None):
+                 shuffle_seed: Optional[int] = None,
+                 n_workers: int = 0):
+        """``n_workers > 0`` decodes via a PROCESS pool — thread-based
+        prefetch cannot scale Python-side decode past the GIL (measured:
+        in-fit decode throughput drops ~4x under dispatch contention);
+        per-image decode is embarrassingly parallel across cores."""
         self.h, self.w, self.c = height, width, channels
+        self.n_workers = int(n_workers)
         if root is not None:
             self.label_names = sorted(
                 d for d in os.listdir(root)
@@ -78,6 +90,20 @@ class ImageRecordReader(RecordReader):
         return len(self.paths)
 
     def __iter__(self):
+        if self.n_workers > 0:
+            import multiprocessing as mp
+            # spawn, NOT fork: __iter__ runs inside the async prefetch
+            # thread while the main thread's JAX runtime holds internal
+            # locks — a fork()ed child can inherit a locked mutex and
+            # hang pool startup.  Worker + args are picklable by design.
+            ctx = mp.get_context("spawn")
+            with ctx.Pool(self.n_workers) as pool:
+                args = [(p, self.h, self.w, self.c) for p in self.paths]
+                for img, lab in zip(
+                        pool.imap(_decode_f32, args, chunksize=16),
+                        self.labels):
+                    yield [img, lab]
+            return
         for p, lab in zip(self.paths, self.labels):
             img = _decode(p, self.h, self.w, self.c).astype(np.float32)
             yield [img, lab]
