@@ -52,6 +52,20 @@ _SIMPLE: Dict[str, str] = {
     "Reshape": "reshape", "ZerosLike": "zeros_like",
     "OnesLike": "ones_like", "GatherNd": "gather_nd", "IsNan": "isnan",
     "IsInf": "isinf", "BroadcastTo": "broadcast_to", "Fill": "fill",
+    # round-3 breadth
+    "Asin": "asin", "Acos": "acos", "Atan": "atan", "Atan2": "atan2",
+    "Sinh": "sinh", "Cosh": "cosh", "Asinh": "asinh", "Acosh": "acosh",
+    "Atanh": "atanh", "Expm1": "expm1", "Rint": "rint",
+    "IsFinite": "isfinite", "Lgamma": "lgamma", "Digamma": "digamma",
+    "Xlogy": "xlogy", "Xdivy": "xdivy", "LogicalXor": "logical_xor",
+    "AddN": "add_n", "L2Loss": "l2_loss",
+    "ClipByValue": "clip_by_value", "InvertPermutation":
+    "invert_permutation", "TensorScatterUpdate": "tensor_scatter_update",
+    "TensorScatterAdd": "tensor_scatter_add",
+    "MatrixInverse": "matrix_inverse", "Cholesky": "cholesky",
+    "MatrixDeterminant": "matrix_determinant",
+    "MatrixDiagPart": "matrix_diag_part",
+    "ReverseV2": "reverse", "Roll": "roll",
 }
 
 _MIN_VAR_SIZE = 2  # float consts with >= this many elements -> VARIABLE
@@ -277,19 +291,19 @@ class _Importer:
                               axis=_tf_attr(node, "axis", -1))
         if op == "Range":
             return self._emit(node, "range", ins)
-        if op == "Cumsum":
-            if _tf_attr(node, "exclusive", False) or _tf_attr(
-                    node, "reverse", False):
-                raise NotImplementedError("exclusive/reverse Cumsum")
+        if op in ("Cumsum", "Cumprod"):
             axis = int(np.asarray(self._const_of(ins[1])).reshape(()))
-            return self._emit(node, "cumsum", ins[:1], axis=axis)
-        if op in ("Pad", "PadV2", "MirrorPad"):
-            if op == "MirrorPad":
-                raise NotImplementedError("MirrorPad")
+            return self._emit(node, op.lower(), ins[:1], axis=axis,
+                              exclusive=_tf_attr(node, "exclusive", False),
+                              reverse=_tf_attr(node, "reverse", False))
+        if op in ("Pad", "PadV2"):
             cv = 0.0
             if op == "PadV2" and len(ins) > 2:
                 cv = float(np.asarray(self._const_of(ins[2])).reshape(()))
             return self._emit(node, "pad", ins[:2], constant_value=cv)
+        if op == "MirrorPad":
+            return self._emit(node, "mirror_pad", ins[:2],
+                              mode=_tf_attr(node, "mode", "REFLECT"))
         if op in ("Select", "SelectV2"):
             return self._emit(node, "select", ins)
         if op == "Conv2D":
@@ -347,6 +361,97 @@ class _Importer:
                                   perm=(0, 3, 1, 2))
             return self._emit(node, "fused_batch_norm", ins, n_out=1,
                               eps=eps)
+        if op == "TopKV2":
+            k = int(np.asarray(self._const_of(ins[1])).reshape(()))
+            return self._emit(node, "top_k", ins[:1], n_out=2, k=k,
+                              sorted=_tf_attr(node, "sorted", True))
+        if op == "MatrixBandPart":
+            return self._emit(node, "matrix_band_part", ins)
+        if op in ("MatrixDiagPartV2", "MatrixDiagPartV3"):
+            k = int(np.asarray(self._const_of(ins[1])).reshape(()))
+            if k != 0:
+                raise NotImplementedError(f"{op} with k={k}")
+            return self._emit(node, "matrix_diag_part", ins[:1])
+        if op in ("DepthToSpace", "SpaceToDepth"):
+            if _tf_attr(node, "data_format", "NHWC") != "NHWC":
+                raise NotImplementedError(f"{op} non-NHWC")
+            name = ("depth_to_space" if op == "DepthToSpace"
+                    else "space_to_depth")
+            return self._emit(node, name, ins,
+                              block_size=_tf_attr(node, "block_size", 2))
+        if op == "SpaceToBatchND":
+            return self._emit(node, "space_to_batch_nd", ins)
+        if op == "BatchToSpaceND":
+            return self._emit(node, "batch_to_space_nd", ins)
+        if op in ("ResizeBilinear", "ResizeNearestNeighbor"):
+            if _tf_attr(node, "align_corners", False):
+                raise NotImplementedError(f"{op} align_corners=True")
+            name = ("resize_bilinear" if op == "ResizeBilinear"
+                    else "resize_nearest")
+            return self._emit(node, name, ins, half_pixel_centers=_tf_attr(
+                node, "half_pixel_centers", True))
+        if op == "LeakyRelu":
+            return self._emit(node, "leaky_relu", ins,
+                              alpha=_tf_attr(node, "alpha", 0.2))
+        if op == "DepthwiseConv2dNative":
+            if _tf_attr(node, "data_format", "NHWC") != "NHWC":
+                raise NotImplementedError("NCHW DepthwiseConv2d")
+            s = _tf_attr(node, "strides", [1, 1, 1, 1])
+            d = _tf_attr(node, "dilations", [1, 1, 1, 1])
+            return self._emit(node, "depthwise_conv2d", ins,
+                              strides=s[1:3],
+                              padding=_tf_attr(node, "padding", "SAME"),
+                              dilations=d[1:3])
+        if op == "Conv2DBackpropInput":
+            # (input_sizes, filter, out_backprop): input_sizes pins the
+            # reconstructed spatial shape (odd sizes under SAME/stride>1)
+            if _tf_attr(node, "data_format", "NHWC") != "NHWC":
+                raise NotImplementedError("NCHW Conv2DBackpropInput")
+            s = _tf_attr(node, "strides", [1, 1, 1, 1])
+            sizes = [int(v) for v in
+                     np.asarray(self._const_of(ins[0])).reshape(-1)]
+            return self._emit(node, "conv2d_transpose",
+                              [ins[2], ins[1]], strides=s[1:3],
+                              padding=_tf_attr(node, "padding", "SAME"),
+                              output_shape=sizes)
+        if op == "Conv3D":
+            s = _tf_attr(node, "strides", [1, 1, 1, 1, 1])
+            d = _tf_attr(node, "dilations", [1, 1, 1, 1, 1])
+            return self._emit(node, "conv3d", ins, strides=s[1:4],
+                              padding=_tf_attr(node, "padding", "SAME"),
+                              dilations=d[1:4])
+        if op in ("MaxPool3D", "AvgPool3D"):
+            k = _tf_attr(node, "ksize", [1, 2, 2, 2, 1])
+            s = _tf_attr(node, "strides", [1, 2, 2, 2, 1])
+            return self._emit(node, f"{op[:-6].lower()}_pool3d", ins,
+                              ksize=k[1:4], strides=s[1:4],
+                              padding=_tf_attr(node, "padding", "VALID"))
+        if op == "LRN":
+            return self._emit(
+                node, "lrn", ins,
+                depth_radius=_tf_attr(node, "depth_radius", 5),
+                bias=_tf_attr(node, "bias", 1.0),
+                alpha=_tf_attr(node, "alpha", 1.0),
+                beta=_tf_attr(node, "beta", 0.5))
+        if op == "SoftmaxCrossEntropyWithLogits":
+            return self._emit(
+                node, "softmax_cross_entropy_with_logits_v2", ins,
+                n_out=2)
+        if op == "SparseSoftmaxCrossEntropyWithLogits":
+            return self._emit(
+                node, "sparse_softmax_cross_entropy_with_logits_v2",
+                ins, n_out=2)
+        if op == "MatrixTriangularSolve":
+            return self._emit(node, "matrix_triangular_solve", ins,
+                              lower=_tf_attr(node, "lower", True),
+                              adjoint=_tf_attr(node, "adjoint", False))
+        if op in ("UnsortedSegmentSum", "UnsortedSegmentMean",
+                  "UnsortedSegmentMax"):
+            name = {"UnsortedSegmentSum": "unsorted_segment_sum",
+                    "UnsortedSegmentMean": "unsorted_segment_mean",
+                    "UnsortedSegmentMax": "unsorted_segment_max"}[op]
+            n_seg = int(np.asarray(self._const_of(ins[2])).reshape(()))
+            return self._emit(node, name, ins[:2], num_segments=n_seg)
         if op in ("StatelessWhile", "While"):
             cond_sd = self._import_function(node.attr["cond"].func.name)
             body_sd = self._import_function(node.attr["body"].func.name)

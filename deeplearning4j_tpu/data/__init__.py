@@ -15,6 +15,10 @@ from deeplearning4j_tpu.data.iterator import (
     ExistingDataSetIterator,
     ListDataSetIterator,
 )
+from deeplearning4j_tpu.data.builtin import (
+    Cifar10DataSetIterator,
+    IrisDataSetIterator,
+)
 from deeplearning4j_tpu.data.normalization import (
     ImagePreProcessingScaler,
     NormalizerMinMaxScaler,
@@ -24,6 +28,7 @@ from deeplearning4j_tpu.data.normalization import (
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
     "ExistingDataSetIterator", "AsyncDataSetIterator",
+    "IrisDataSetIterator", "Cifar10DataSetIterator",
     "NormalizerStandardize", "NormalizerMinMaxScaler",
     "ImagePreProcessingScaler",
 ]

@@ -14,7 +14,8 @@ that keeps the MXU busy and needs no parameter locking at all).
 from deeplearning4j_tpu.nlp.tokenizer import (DefaultTokenizerFactory,
                                               RegexTokenizerFactory)
 from deeplearning4j_tpu.nlp.word2vec import ParagraphVectors, Word2Vec
+from deeplearning4j_tpu.nlp.fasttext import FastText
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
 
-__all__ = ["Word2Vec", "ParagraphVectors", "DefaultTokenizerFactory",
+__all__ = ["Word2Vec", "ParagraphVectors", "FastText", "DefaultTokenizerFactory",
            "RegexTokenizerFactory", "WordVectorSerializer"]

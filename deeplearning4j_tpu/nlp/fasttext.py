@@ -1,0 +1,175 @@
+"""FastText — subword-enriched skip-gram embeddings.
+
+Parity surface: ``org.deeplearning4j.models.fasttext.FastText``
+[UNVERIFIED] (wrapping facebookresearch/fastText semantics): each word
+vector is the MEAN of its word row and its character n-gram (3..6,
+word wrapped in ``< >``) bucket rows, FNV-1a-hashed into ``bucket``
+slots; OOV words get vectors from their n-grams alone — the FastText
+hallmark.
+
+TPU-first training: the per-word subword id lists are precomputed host
+side into one padded [n_vocab, S] table; the negative-sampling step
+gathers and mean-combines rows in one batched segment computation and
+scatter-adds the distributed gradients — same single-jitted-step shape
+as Word2Vec (no per-token host loop).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+def fnv1a(s: str) -> int:
+    """FNV-1a 32-bit (the hash fastText uses for n-gram buckets)."""
+    h = 2166136261
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def word_ngrams(word: str, min_n: int = 3, max_n: int = 6) -> List[str]:
+    w = f"<{word}>"
+    out = []
+    for n in range(min_n, max_n + 1):
+        for i in range(len(w) - n + 1):
+            g = w[i:i + n]
+            if g != w:           # the full token is the word row itself
+                out.append(g)
+    return out
+
+
+@dataclasses.dataclass
+class FastText(Word2Vec):
+    bucket: int = 50000            # n-gram hash buckets (fastText: 2M)
+    min_n: int = 3
+    max_n: int = 6
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.subword_table: Optional[np.ndarray] = None  # [n_vocab, S]
+        self.subword_mask: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def _ngram_ids(self, word: str) -> List[int]:
+        return [fnv1a(g) % self.bucket
+                for g in word_ngrams(word, self.min_n, self.max_n)]
+
+    def _build_subword_table(self):
+        """Padded per-word subword bucket ids (offset by n_vocab — the
+        bucket rows live after the word rows in syn0)."""
+        n_vocab = len(self.vocab)
+        lists = [self._ngram_ids(w) for w in self.index2word]
+        s_max = max(1, max(len(l) for l in lists))
+        table = np.zeros((n_vocab, s_max), np.int32)
+        mask = np.zeros((n_vocab, s_max), np.float32)
+        for i, l in enumerate(lists):
+            table[i, :len(l)] = [n_vocab + g for g in l]
+            mask[i, :len(l)] = 1.0
+        self.subword_table, self.subword_mask = table, mask
+
+    # ------------------------------------------------------------------
+    def _make_step(self, n_vocab: int):
+        neg = self.negative
+        cdf = self._unigram_cdf(n_vocab)
+        sub_t = jnp.asarray(self.subword_table)
+        sub_m = jnp.asarray(self.subword_mask)
+
+        def sample_negatives(key, b):
+            if cdf is None:
+                return jax.random.randint(key, (b, neg), 0, n_vocab)
+            u = jax.random.uniform(key, (b, neg))
+            return jnp.clip(jnp.searchsorted(cdf, u), 0,
+                            n_vocab - 1).astype(jnp.int32)
+
+        def step(syn0, syn1, centers, contexts, lr, key):
+            b = centers.shape[0]
+            negs = sample_negatives(key, b)
+            subs = sub_t[centers]                # [b, S]
+            smask = sub_m[centers]               # [b, S]
+            counts = 1.0 + smask.sum(-1)         # word row + n-grams
+            v_c = (syn0[centers] +
+                   jnp.einsum("bsd,bs->bd", syn0[subs], smask)
+                   ) / counts[:, None]
+            u_pos = syn1[contexts]
+            u_neg = syn1[negs]
+            pos_score = jnp.sum(v_c * u_pos, -1)
+            neg_score = jnp.einsum("bd,bnd->bn", v_c, u_neg)
+            loss = -(jnp.mean(jax.nn.log_sigmoid(pos_score)) +
+                     jnp.mean(jnp.sum(jax.nn.log_sigmoid(-neg_score),
+                                      -1)))
+            g_pos = jax.nn.sigmoid(pos_score) - 1.0
+            g_neg = jax.nn.sigmoid(neg_score)
+            d_vc = g_pos[:, None] * u_pos + jnp.einsum(
+                "bn,bnd->bd", g_neg, u_neg)
+            d_upos = g_pos[:, None] * v_c
+            d_uneg = g_neg[..., None] * v_c[:, None, :]
+            # distribute the center gradient over word + subword rows
+            d_rows = d_vc / counts[:, None]
+            syn0 = syn0.at[centers].add(-lr * d_rows / b)
+            d_sub = d_rows[:, None, :] * smask[..., None]  # [b,S,d]
+            syn0 = syn0.at[subs.reshape(-1)].add(
+                -lr * d_sub.reshape(-1, d_sub.shape[-1]) / b)
+            syn1 = syn1.at[contexts].add(-lr * d_upos / b)
+            syn1 = syn1.at[negs.reshape(-1)].add(
+                -lr * d_uneg.reshape(-1, d_uneg.shape[-1]) / b)
+            return syn0, syn1, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+    def fit(self, sentences: Sequence[str]) -> List[float]:
+        token_lists = [self.tokenizer_factory.tokenize(s)
+                       for s in sentences]
+        self._build_vocab(token_lists)
+        n_vocab = len(self.vocab)
+        if n_vocab == 0:
+            raise ValueError("Empty vocabulary (check min_word_frequency)")
+        if self.use_hierarchic_softmax:
+            raise NotImplementedError(
+                "FastText here trains with negative sampling "
+                "(fastText's own default); use Word2Vec for HS")
+        self._build_subword_table()
+        rng = np.random.default_rng(self.seed)
+        pairs_all = self._pairs(token_lists, rng)
+        self.syn0, self.syn1, losses = self._train_pairs(
+            pairs_all, n_vocab, n_vocab + self.bucket, rng)
+        return losses
+
+    # ------------------------------------------------------------------
+    def get_word_vector(self, w: str) -> np.ndarray:
+        """In-vocab: mean of word row + n-gram rows.  OOV: mean of the
+        n-gram rows alone (never raises — the FastText contract)."""
+        n_vocab = len(self.vocab)
+        grams = [n_vocab + g for g in self._ngram_ids(w)]
+        if w in self.vocab:
+            rows = [self.syn0[self.vocab[w]]] + [self.syn0[g]
+                                                 for g in grams]
+        elif grams:
+            rows = [self.syn0[g] for g in grams]
+        else:
+            return np.zeros(self.vector_size, np.float32)
+        return np.mean(rows, axis=0)
+
+    def has_word(self, w: str) -> bool:   # OOV still has a vector
+        return True
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        return float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb)
+                                + 1e-12))
+
+    def words_nearest(self, w: str, n: int = 10) -> List[str]:
+        # full subword-composed vectors, NOT raw syn0 rows (those
+        # include the n-gram bucket rows past the vocabulary)
+        v = self.get_word_vector(w)
+        mat = np.stack([self.get_word_vector(x) for x in self.index2word])
+        norms = np.linalg.norm(mat, axis=1) + 1e-12
+        sims = mat @ v / (norms * (np.linalg.norm(v) + 1e-12))
+        order = np.argsort(-sims)
+        return [self.index2word[i] for i in order
+                if self.index2word[i] != w][:n]

@@ -10,6 +10,12 @@ not FLOPs).
 from deeplearning4j_tpu.rl.mdp import MDP, SimpleGridWorld
 from deeplearning4j_tpu.rl.dqn import (DQNPolicy, QLearningConfiguration,
                                        QLearningDiscrete, ReplayBuffer)
+from deeplearning4j_tpu.rl.a3c import (A3CConfiguration, A3CDiscrete,
+                                       ACPolicy,
+                                       AsyncNStepQConfiguration,
+                                       AsyncNStepQLearningDiscrete)
 
 __all__ = ["MDP", "SimpleGridWorld", "QLearningDiscrete",
-           "QLearningConfiguration", "ReplayBuffer", "DQNPolicy"]
+           "QLearningConfiguration", "ReplayBuffer", "DQNPolicy",
+           "A3CDiscrete", "A3CConfiguration", "ACPolicy",
+           "AsyncNStepQLearningDiscrete", "AsyncNStepQConfiguration"]

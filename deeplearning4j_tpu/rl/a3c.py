@@ -1,0 +1,399 @@
+"""Asynchronous advantage actor-critic (A3C) + async n-step Q-learning.
+
+Parity surface: ``rl4j-core org.deeplearning4j.rl4j.learning.async.**``
+(``A3CDiscrete``, ``AsyncNStepQLearningDiscrete``) [UNVERIFIED] — the
+reference runs actor THREADS with local network copies applying
+asynchronous gradients to a shared global network.
+
+TPU-first translation: actors are host threads (environment stepping is
+cheap numpy control flow; the GIL releases during jitted device calls),
+each takes a parameter snapshot, collects a t_max rollout, computes
+gradients with ONE jitted call, and applies them to the shared
+parameters under a lock — the Hogwild-style async semantic with the
+math on the accelerator.  Both learners share the
+``_AsyncActorLearner`` scaffolding (rollout template, truncation
+bootstrapping, locked updates, thread fan-out); they differ only in
+action selection, the bootstrap value, and the gradient function.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.rl.mdp import MDP
+
+
+def discounted_returns(rewards, bootstrap, dones, gamma):
+    """Backward-accumulated n-step returns; a True in ``dones`` resets
+    the accumulator (rollouts break at terminal steps, so at most the
+    final entry is True)."""
+    out = np.zeros(len(rewards), np.float32)
+    acc = bootstrap
+    for i in range(len(rewards) - 1, -1, -1):
+        acc = rewards[i] + gamma * (0.0 if dones[i] else acc)
+        out[i] = acc
+    return out
+
+
+class _AsyncActorLearner:
+    """Shared async-learning scaffolding.  Subclasses set, in __init__:
+    ``conf`` (n_threads/t_max/gamma/max_step/max_epoch_step/seed),
+    ``mdp_factory``, ``_updater``, ``_opt_state``, and implement
+    ``_get_params``/``_set_params``, ``_snapshot``, ``_select_action``,
+    ``_bootstrap_value``, ``_rollout_grads``, and optionally
+    ``_post_apply`` (e.g. target-network sync)."""
+
+    def _init_shared(self):
+        self._lock = threading.Lock()
+        self.step_count = 0
+        self.episode_rewards: List[float] = []
+
+    # -- subclass surface ----------------------------------------------
+    def _get_params(self):
+        raise NotImplementedError
+
+    def _set_params(self, params):
+        raise NotImplementedError
+
+    def _snapshot(self):
+        with self._lock:
+            return self._get_params()
+
+    def _select_action(self, snap, obs, rng) -> int:
+        raise NotImplementedError
+
+    def _bootstrap_value(self, snap, obs) -> float:
+        raise NotImplementedError
+
+    def _rollout_grads(self, snap, obs_batch, actions, returns):
+        raise NotImplementedError
+
+    def _post_apply(self):
+        pass
+
+    # -- shared machinery ----------------------------------------------
+    def _apply(self, grads):
+        import jax
+        with self._lock:
+            params = self._get_params()
+            updates, self._opt_state = self._updater.update(
+                grads, self._opt_state, params, self.step_count)
+            params = jax.tree_util.tree_map(
+                lambda p, u: p - u, params, updates)
+            self._opt_state = self._updater.finalize(self._opt_state,
+                                                     params)
+            self._set_params(params)
+            self._post_apply()
+
+    def _actor(self, tid: int):
+        import jax.numpy as jnp
+        c = self.conf
+        mdp = self.mdp_factory()
+        rng = np.random.default_rng(c.seed * 1009 + tid)
+        obs = mdp.reset()
+        ep_reward, ep_steps = 0.0, 0
+        while self.step_count < c.max_step:
+            snap = self._snapshot()
+            os_, as_, rs_, ds_ = [], [], [], []
+            boot_obs = obs     # state to bootstrap from if truncated
+            for _ in range(c.t_max):
+                a = self._select_action(snap, obs, rng)
+                obs2, r, done = mdp.step(a)
+                os_.append(obs)
+                as_.append(a)
+                rs_.append(r)
+                ds_.append(done)
+                obs = boot_obs = obs2
+                ep_reward += r
+                ep_steps += 1
+                self.step_count += 1
+                if done or ep_steps >= c.max_epoch_step:
+                    with self._lock:
+                        self.episode_rewards.append(ep_reward)
+                    # boot_obs keeps the PRE-reset state: an epoch-limit
+                    # truncation still bootstraps from where the
+                    # rollout actually stopped
+                    obs, ep_reward, ep_steps = mdp.reset(), 0.0, 0
+                    break
+            bootstrap = 0.0 if ds_[-1] else \
+                self._bootstrap_value(snap, boot_obs)
+            returns = discounted_returns(rs_, bootstrap, ds_, c.gamma)
+            grads = self._rollout_grads(
+                snap, jnp.asarray(np.stack(os_), jnp.float32),
+                jnp.asarray(np.asarray(as_), jnp.int32),
+                jnp.asarray(returns))
+            self._apply(grads)
+        mdp.close()
+
+    def train(self) -> List[float]:
+        threads = [threading.Thread(target=self._actor, args=(t,))
+                   for t in range(self.conf.n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return self.episode_rewards
+
+
+# ---------------------------------------------------------------------------
+# A3C
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class A3CConfiguration:
+    n_threads: int = 2
+    t_max: int = 8                 # rollout length per async update
+    gamma: float = 0.95
+    entropy_beta: float = 0.01
+    value_coef: float = 0.5
+    learning_rate: float = 3e-3
+    max_step: int = 6000           # total env steps across all actors
+    max_epoch_step: int = 100
+    seed: int = 0
+
+
+def _build_ac_graph(obs_size: int, n_actions: int, hidden: int,
+                    lr: float, seed: int):
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                        OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=lr))
+            .graph()
+            .add_inputs("obs")
+            .set_input_types(InputType.feed_forward(obs_size))
+            .add_layer("h1", DenseLayer(n_out=hidden, activation="relu"),
+                       "obs")
+            .add_layer("h2", DenseLayer(n_out=hidden, activation="relu"),
+                       "h1")
+            .add_layer("policy", OutputLayer(n_out=n_actions,
+                                             activation="identity",
+                                             loss="mse"), "h2")
+            .add_layer("value", OutputLayer(n_out=1,
+                                            activation="identity",
+                                            loss="mse"), "h2")
+            .set_outputs("policy", "value")
+            .build())
+    return ComputationGraph(conf).init()
+
+
+class A3CDiscrete(_AsyncActorLearner):
+    """A3C over a discrete-action MDP; ``mdp_factory()`` builds one
+    environment per actor thread.  The actor-critic network is a
+    framework ``ComputationGraph`` with policy/value heads; the A3C
+    loss (policy-gradient x advantage + entropy bonus + value
+    regression — rl4j ``ActorCriticLoss``) is a custom jitted function
+    over the graph's pure forward."""
+
+    def __init__(self, mdp_factory: Callable[[], MDP],
+                 conf: Optional[A3CConfiguration] = None,
+                 hidden: int = 64):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        self.conf = conf or A3CConfiguration()
+        c = self.conf
+        self.mdp_factory = mdp_factory
+        probe = mdp_factory()
+        self.n_actions = probe.n_actions
+        self.graph = _build_ac_graph(probe.obs_size, self.n_actions,
+                                     hidden, c.learning_rate, c.seed)
+        probe.close()
+        self._updater = Adam(learning_rate=c.learning_rate)
+        self._opt_state = self._updater.init_state(self.graph.params_tree)
+        self._init_shared()
+
+        graph, beta, vc = self.graph, c.entropy_beta, c.value_coef
+
+        def loss_fn(params, obs, actions, returns):
+            outs = graph._forward_infer(params, graph.state_tree,
+                                        {"obs": obs})
+            logits = outs["policy"].astype(jnp.float32)
+            value = outs["value"].astype(jnp.float32)[:, 0]
+            logp = jax.nn.log_softmax(logits, -1)
+            p = jnp.exp(logp)
+            adv = jax.lax.stop_gradient(returns - value)
+            taken = jnp.take_along_axis(
+                logp, actions[:, None].astype(jnp.int32), 1)[:, 0]
+            policy_loss = -jnp.mean(taken * adv)
+            entropy = -jnp.mean(jnp.sum(p * logp, -1))
+            value_loss = jnp.mean(jnp.square(returns - value))
+            return policy_loss - beta * entropy + vc * value_loss
+
+        self._grads = jax.jit(jax.grad(loss_fn))
+        self._policy_fwd = jax.jit(
+            lambda params, obs: jax.nn.softmax(
+                graph._forward_infer(params, graph.state_tree,
+                                     {"obs": obs})["policy"], -1))
+        self._value_fwd = jax.jit(
+            lambda params, obs: graph._forward_infer(
+                params, graph.state_tree, {"obs": obs})["value"])
+
+    def _get_params(self):
+        return self.graph.params_tree
+
+    def _set_params(self, params):
+        self.graph.params_tree = params
+
+    def _select_action(self, snap, obs, rng) -> int:
+        import jax.numpy as jnp
+        probs = np.asarray(self._policy_fwd(
+            snap, jnp.asarray(obs[None], jnp.float32)))[0]
+        return int(rng.choice(self.n_actions, p=probs / probs.sum()))
+
+    def _bootstrap_value(self, snap, obs) -> float:
+        import jax.numpy as jnp
+        return float(np.asarray(self._value_fwd(
+            snap, jnp.asarray(obs[None], jnp.float32)))[0, 0])
+
+    def _rollout_grads(self, snap, obs_batch, actions, returns):
+        return self._grads(snap, obs_batch, actions, returns)
+
+    def get_policy(self):
+        return ACPolicy(self)
+
+
+class ACPolicy:
+    """Greedy policy over the trained actor head (rl4j ``ACPolicy``)."""
+
+    def __init__(self, learner: A3CDiscrete):
+        self.learner = learner
+
+    def next_action(self, obs: np.ndarray) -> int:
+        import jax.numpy as jnp
+        probs = np.asarray(self.learner._policy_fwd(
+            self.learner.graph.params_tree,
+            jnp.asarray(obs[None], jnp.float32)))[0]
+        return int(probs.argmax())
+
+    def play(self, mdp: MDP, max_steps: int = 1000) -> float:
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done = mdp.step(self.next_action(obs))
+            total += r
+            if done:
+                break
+        return total
+
+
+# ---------------------------------------------------------------------------
+# Async n-step Q-learning
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class AsyncNStepQConfiguration:
+    n_threads: int = 2
+    t_max: int = 5                 # the n of n-step
+    gamma: float = 0.95
+    learning_rate: float = 3e-3
+    max_step: int = 6000
+    max_epoch_step: int = 100
+    target_update_freq: int = 200
+    eps_start: float = 1.0
+    eps_end: float = 0.05
+    eps_decay_steps: int = 3000
+    seed: int = 0
+
+
+class AsyncNStepQLearningDiscrete(_AsyncActorLearner):
+    """Async n-step Q-learning (rl4j ``AsyncNStepQLearningDiscrete``):
+    actors collect n-step rollouts, compute TD targets against a shared
+    target network, and apply gradients to the shared Q-network —
+    replay-free asynchronous Q-learning."""
+
+    def __init__(self, mdp_factory: Callable[[], MDP],
+                 conf: Optional[AsyncNStepQConfiguration] = None,
+                 hidden: int = 64):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu import (MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                            OutputLayer)
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        self.conf = conf or AsyncNStepQConfiguration()
+        c = self.conf
+        self.mdp_factory = mdp_factory
+        probe = mdp_factory()
+        self.n_actions = probe.n_actions
+
+        cfg = (NeuralNetConfiguration.builder().seed(c.seed)
+               .updater(Adam(learning_rate=c.learning_rate)).list()
+               .layer(DenseLayer(n_in=probe.obs_size, n_out=hidden,
+                                 activation="relu"))
+               .layer(DenseLayer(n_out=hidden, activation="relu"))
+               .layer(OutputLayer(n_out=self.n_actions,
+                                  activation="identity", loss="mse"))
+               .build())
+        self.q_net = MultiLayerNetwork(cfg).init()
+        probe.close()
+        self._target = jax.tree_util.tree_map(
+            lambda a: jnp.array(a, copy=True), self.q_net.params_tree)
+        self._updater = Adam(learning_rate=c.learning_rate)
+        self._opt_state = self._updater.init_state(self.q_net.params_tree)
+        self._init_shared()
+
+        net = self.q_net
+
+        def q_of(params, obs):
+            h, _ = net._forward_layers(params, net.state_tree, obs,
+                                       False, None)
+            return h
+
+        def loss_fn(params, obs, actions, targets):
+            q = q_of(params, obs).astype(jnp.float32)
+            taken = jnp.take_along_axis(
+                q, actions[:, None].astype(jnp.int32), 1)[:, 0]
+            return jnp.mean(jnp.square(targets - taken))
+
+        self._grads = jax.jit(jax.grad(loss_fn))
+        self._q_fwd = jax.jit(q_of)
+
+    def _get_params(self):
+        return self.q_net.params_tree
+
+    def _set_params(self, params):
+        self.q_net.params_tree = params
+
+    def _snapshot(self):
+        with self._lock:
+            return (self.q_net.params_tree, self._target)
+
+    def _epsilon(self) -> float:
+        c = self.conf
+        frac = min(1.0, self.step_count / max(1, c.eps_decay_steps))
+        return c.eps_start + frac * (c.eps_end - c.eps_start)
+
+    def _select_action(self, snap, obs, rng) -> int:
+        import jax.numpy as jnp
+        if rng.random() < self._epsilon():
+            return int(rng.integers(0, self.n_actions))
+        q = np.asarray(self._q_fwd(
+            snap[0], jnp.asarray(obs[None], jnp.float32)))
+        return int(q[0].argmax())
+
+    def _bootstrap_value(self, snap, obs) -> float:
+        import jax.numpy as jnp
+        q = np.asarray(self._q_fwd(
+            snap[1], jnp.asarray(obs[None], jnp.float32)))
+        return float(q[0].max())
+
+    def _rollout_grads(self, snap, obs_batch, actions, returns):
+        return self._grads(snap[0], obs_batch, actions, returns)
+
+    def _post_apply(self):
+        if self.step_count % self.conf.target_update_freq < \
+                self.conf.t_max:
+            import jax
+            import jax.numpy as jnp
+            self._target = jax.tree_util.tree_map(
+                lambda a: jnp.array(a, copy=True),
+                self.q_net.params_tree)

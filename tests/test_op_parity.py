@@ -16,6 +16,15 @@ B34 = rng.normal(size=(3, 4)).astype(np.float32)
 M45 = rng.normal(size=(4, 5)).astype(np.float32)
 T234 = rng.normal(size=(2, 3, 4)).astype(np.float32)
 POS34 = (np.abs(A34) + 0.1).astype(np.float32)
+DW_FILTER = tf.constant(
+    np.random.default_rng(1).normal(size=(3, 3, 3, 2)).astype(np.float32))
+CT_FILTER = tf.constant(
+    np.random.default_rng(2).normal(size=(3, 3, 3, 5)).astype(np.float32))
+C3_FILTER = tf.constant(
+    np.random.default_rng(3).normal(size=(2, 2, 2, 2, 4))
+    .astype(np.float32))
+_spd = np.random.default_rng(4).normal(size=(4, 4)).astype(np.float32)
+SPD44 = (_spd @ _spd.T + 4.0 * np.eye(4, dtype=np.float32))
 
 # (name, tf_fn, inputs) — each imports one (or a few) TF ops.
 CASES = [
@@ -76,6 +85,117 @@ CASES = [
     ("cumsum", lambda a: tf.cumsum(a, axis=1), (A34,)),
     ("broadcast", lambda a: a + tf.ones((3, 1)), (A34,)),
     ("einsum", lambda a, b: tf.einsum("ij,jk->ik", a, b), (A34, M45)),
+    # --- round-3 breadth ---------------------------------------------
+    ("asin", tf.asin, (np.clip(A34, -0.9, 0.9),)),
+    ("acos", tf.acos, (np.clip(A34, -0.9, 0.9),)),
+    ("atan", tf.atan, (A34,)),
+    ("atan2", tf.atan2, (A34, B34)),
+    ("sinh", tf.sinh, (A34,)),
+    ("cosh", tf.cosh, (A34,)),
+    ("asinh", tf.asinh, (A34,)),
+    ("acosh", tf.acosh, (POS34 + 1.0,)),
+    ("atanh", tf.atanh, (np.clip(A34, -0.9, 0.9),)),
+    ("expm1", tf.math.expm1, (A34,)),
+    ("rint", tf.math.rint, (3.3 * A34,)),
+    ("lgamma", tf.math.lgamma, (POS34,)),
+    ("digamma", tf.math.digamma, (POS34,)),
+    ("xlogy", tf.math.xlogy, (np.abs(A34), POS34)),
+    ("xdivy", tf.math.xdivy, (A34, POS34)),
+    ("is_finite", lambda a: tf.cast(tf.math.is_finite(a / (a - a[0, 0])),
+                                    tf.float32), (A34,)),
+    ("add_n", lambda a, b: tf.add_n([a, b, a]), (A34, B34)),
+    ("l2_loss", tf.nn.l2_loss, (A34,)),
+    ("clip_by_value", lambda a: tf.clip_by_value(a, -0.5, 0.5), (A34,)),
+    ("leaky_relu", lambda a: tf.nn.leaky_relu(a, alpha=0.3), (A34,)),
+    ("reverse", lambda a: tf.reverse(a, axis=[1]), (A34,)),
+    ("roll", lambda a: tf.roll(a, shift=[1, -2], axis=[0, 1]), (A34,)),
+    ("top_k_values", lambda a: tf.math.top_k(a, k=2).values, (A34,)),
+    ("top_k_indices", lambda a: tf.cast(tf.math.top_k(a, k=2).indices,
+                                        tf.float32), (A34,)),
+    ("invert_permutation", lambda: tf.cast(
+        tf.math.invert_permutation([2, 0, 3, 1]), tf.float32), ()),
+    ("matrix_band_part", lambda a: tf.linalg.band_part(a, 1, 1),
+     (rng.normal(size=(4, 4)).astype(np.float32),)),
+    ("mirror_pad_reflect", lambda a: tf.pad(a, [[1, 1], [2, 0]],
+                                            mode="REFLECT"), (A34,)),
+    ("mirror_pad_symmetric", lambda a: tf.pad(a, [[1, 1], [0, 2]],
+                                              mode="SYMMETRIC"), (A34,)),
+    ("cumsum_exclusive", lambda a: tf.cumsum(a, axis=1, exclusive=True),
+     (A34,)),
+    ("cumsum_reverse", lambda a: tf.cumsum(a, axis=0, reverse=True),
+     (A34,)),
+    ("cumprod", lambda a: tf.math.cumprod(a, axis=1), (POS34,)),
+    ("tensor_scatter_update", lambda a: tf.tensor_scatter_nd_update(
+        a, [[0], [2]], tf.zeros((2, 4))), (A34,)),
+    ("tensor_scatter_add", lambda a: tf.tensor_scatter_nd_add(
+        a, [[1], [1]], tf.ones((2, 4))), (A34,)),
+    ("depth_to_space", lambda a: tf.nn.depth_to_space(a, 2),
+     (rng.normal(size=(1, 2, 3, 8)).astype(np.float32),)),
+    ("space_to_depth", lambda a: tf.nn.space_to_depth(a, 2),
+     (rng.normal(size=(1, 4, 6, 2)).astype(np.float32),)),
+    ("space_to_batch_nd", lambda a: tf.space_to_batch(
+        a, [2, 2], [[0, 0], [0, 0]]),
+     (rng.normal(size=(1, 4, 4, 3)).astype(np.float32),)),
+    ("batch_to_space_nd", lambda a: tf.batch_to_space(
+        a, [2, 2], [[0, 0], [0, 0]]),
+     (rng.normal(size=(4, 2, 2, 3)).astype(np.float32),)),
+    ("resize_bilinear", lambda a: tf.compat.v1.image.resize_bilinear(
+        a, [6, 8], half_pixel_centers=True),
+     (rng.normal(size=(1, 3, 4, 2)).astype(np.float32),)),
+    ("resize_nearest", lambda a: tf.compat.v1.image.resize_nearest_neighbor(
+        a, [6, 8], half_pixel_centers=True),
+     (rng.normal(size=(1, 3, 4, 2)).astype(np.float32),)),
+    # legacy corner-anchored sampling is the TF ATTR DEFAULT (r3 review)
+    ("resize_bilinear_legacy", lambda a: tf.compat.v1.image.resize_bilinear(
+        a, [6, 8]), (rng.normal(size=(1, 3, 4, 2)).astype(np.float32),)),
+    ("resize_nearest_legacy",
+     lambda a: tf.compat.v1.image.resize_nearest_neighbor(
+         a, [6, 8]), (rng.normal(size=(1, 3, 4, 2)).astype(np.float32),)),
+    # odd input size under SAME/stride-2: input_sizes must pin the shape
+    ("conv2d_transpose_odd", lambda a: tf.nn.conv2d_transpose(
+        a, CT_FILTER, output_shape=[2, 5, 5, 3], strides=[1, 2, 2, 1],
+        padding="SAME"),
+     (rng.normal(size=(2, 3, 3, 5)).astype(np.float32),)),
+    ("conv2d_transpose_valid", lambda a: tf.nn.conv2d_transpose(
+        a, CT_FILTER, output_shape=[2, 9, 9, 3], strides=[1, 2, 2, 1],
+        padding="VALID"),
+     (rng.normal(size=(2, 4, 4, 5)).astype(np.float32),)),
+    ("unsorted_segment_sum", lambda a: tf.math.unsorted_segment_sum(
+        a, [1, 0, 1], 2), (A34,)),
+    ("unsorted_segment_mean", lambda a: tf.math.unsorted_segment_mean(
+        a, [1, 0, 1], 2), (A34,)),
+    ("unsorted_segment_max", lambda a: tf.math.unsorted_segment_max(
+        a, [0, 0, 1], 2), (A34,)),
+    ("depthwise_conv2d", lambda a: tf.nn.depthwise_conv2d(
+        a, DW_FILTER, strides=[1, 1, 1, 1], padding="SAME"),
+     (rng.normal(size=(2, 6, 6, 3)).astype(np.float32),)),
+    ("conv2d_transpose", lambda a: tf.nn.conv2d_transpose(
+        a, CT_FILTER, output_shape=[2, 8, 8, 3], strides=[1, 2, 2, 1],
+        padding="SAME"),
+     (rng.normal(size=(2, 4, 4, 5)).astype(np.float32),)),
+    ("conv3d", lambda a: tf.nn.conv3d(
+        a, C3_FILTER, strides=[1, 1, 1, 1, 1], padding="SAME"),
+     (rng.normal(size=(1, 4, 4, 4, 2)).astype(np.float32),)),
+    ("max_pool3d", lambda a: tf.nn.max_pool3d(
+        a, ksize=2, strides=2, padding="VALID"),
+     (rng.normal(size=(1, 4, 4, 4, 2)).astype(np.float32),)),
+    ("avg_pool3d", lambda a: tf.nn.avg_pool3d(
+        a, ksize=2, strides=2, padding="VALID"),
+     (rng.normal(size=(1, 4, 4, 4, 2)).astype(np.float32),)),
+    ("lrn", lambda a: tf.nn.local_response_normalization(
+        a, depth_radius=2, bias=1.0, alpha=0.5, beta=0.6),
+     (rng.normal(size=(1, 3, 3, 8)).astype(np.float32),)),
+    ("softmax_ce_logits", lambda a: tf.nn.softmax_cross_entropy_with_logits(
+        labels=tf.nn.softmax(tf.ones_like(a)), logits=a), (A34,)),
+    ("sparse_softmax_ce", lambda a:
+     tf.nn.sparse_softmax_cross_entropy_with_logits(
+         labels=[0, 2, 1], logits=a), (A34,)),
+    ("matrix_inverse", lambda a: tf.linalg.inv(a), (SPD44,)),
+    ("cholesky", lambda a: tf.linalg.cholesky(a), (SPD44,)),
+    ("matrix_determinant", lambda a: tf.linalg.det(a), (SPD44,)),
+    ("matrix_diag_part", lambda a: tf.linalg.diag_part(a), (SPD44,)),
+    ("matrix_triangular_solve", lambda a: tf.linalg.triangular_solve(
+        tf.linalg.cholesky(a), tf.ones((4, 2)), lower=True), (SPD44,)),
 ]
 
 
