@@ -28,10 +28,13 @@ initialize_distributed = initialize
 
 from deeplearning4j_tpu.parallel.ring_attention import (
     ring_attention, ring_self_attention)
+from deeplearning4j_tpu.parallel.pipeline import (
+    PipelinedTransformerLM, gpipe_apply, stack_block_params)
 from deeplearning4j_tpu.parallel.scaling import measure_scaling
 
 __all__ = ["MeshConfig", "ShardedTrainer", "ParallelInference",
            "initialize", "initialize_distributed", "global_mesh",
            "host_local_batch_to_global", "ShardedCheckpointer",
            "CheckpointListener", "ring_attention", "ring_self_attention",
+           "gpipe_apply", "stack_block_params", "PipelinedTransformerLM",
            "measure_scaling"]

@@ -1,0 +1,184 @@
+"""Pipeline parallelism — GPipe microbatching over a 'pipe' mesh axis.
+
+The LAST parallelism axis from SURVEY §2.3 ("absent in the reference;
+design the trainer so stages are expressible later").  TPU-native
+design: stages are expressed as SPMD — every device runs the SAME
+program under ``shard_map``; the stage's parameter slice arrives via a
+``P('pipe')``-sharded leading axis, microbatch activations rotate
+around the ring with ``lax.ppermute``, and the whole schedule is a
+``lax.scan`` (compiler-friendly: one compiled step, no per-stage
+Python).  Backward is ``jax.grad`` THROUGH the scheduled forward —
+scan+ppermute are differentiable, so the GPipe backward pass (reverse
+schedule with re-rotated cotangents) falls out of autodiff instead of
+being hand-built.
+
+Scope: homogeneous stacks (N identical blocks, e.g.
+``TransformerEncoderBlock``) — the case pipeline parallelism exists
+for.  N must divide by the pipe-axis size; each stage owns N/S
+consecutive blocks and scans over them locally.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_block_params(block_conf, n_blocks: int, key,
+                       dtype=jnp.float32):
+    """Init n_blocks independent parameter sets and stack each leaf on
+    a leading axis — the array layout the pipe axis shards."""
+    keys = jax.random.split(key, n_blocks)
+    trees = [block_conf.init(k, dtype)[0] for k in keys]
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *trees)
+
+
+def gpipe_apply(mesh: Mesh, stacked_params, x, block_apply: Callable,
+                n_micro: int, axis: str = "pipe"):
+    """Run x [B, ...] through the stacked blocks with a GPipe schedule.
+
+    ``block_apply(params_one_block, activations) -> activations`` is
+    the per-block forward.  ``n_micro`` microbatches must divide B; the
+    bubble fraction is (S-1)/(S-1+n_micro).  Returns [B, ...] with the
+    pipeline semantics IDENTICAL to applying the blocks sequentially.
+    """
+    S = mesh.shape[axis]
+    B = x.shape[0]
+    n_blocks = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_blocks % S:
+        raise ValueError(f"{n_blocks} blocks do not divide over "
+                         f"{S} pipeline stages")
+    if B % n_micro:
+        raise ValueError(f"batch {B} must divide into {n_micro} "
+                         "microbatches")
+    xm = x.reshape((n_micro, B // n_micro) + x.shape[1:])
+
+    def apply_stage(params_local, h):
+        def body(carry, p):
+            return block_apply(p, carry), None
+        out, _ = lax.scan(body, h, params_local)
+        return out
+
+    def worker(params_local, xm):
+        idx = lax.axis_index(axis)
+        # the scan carry becomes pipe-varying after the first ppermute;
+        # pre-cast the zeros so the carry type is stable across ticks
+        state = lax.pcast(jnp.zeros_like(xm[0]), (axis,), to="varying")
+
+        def tick(state, t):
+            # stage 0 ingests microbatch t (clamped: late ticks feed
+            # garbage that never reaches the collected outputs)
+            inject = xm[jnp.clip(t, 0, n_micro - 1)]
+            h = jnp.where(idx == 0, inject, state)
+            y = apply_stage(params_local, h)
+            nxt = lax.ppermute(
+                y, axis, [(i, (i + 1) % S) for i in range(S)])
+            return nxt, y
+
+        _, ys = lax.scan(tick, state, jnp.arange(S + n_micro - 1))
+        # microbatch m leaves the LAST stage at tick (S-1) + m
+        outs = lax.dynamic_slice_in_dim(ys, S - 1, n_micro, axis=0)
+        # where, NOT outs*mask: bubble-tick garbage on non-last stages
+        # may be non-finite and 0*NaN would poison the psum
+        outs = jnp.where(idx == S - 1, outs, jnp.zeros_like(outs))
+        # replicate the last stage's outputs to every device
+        return lax.psum(outs, axis)
+
+    out = jax.shard_map(
+        worker, mesh=mesh,
+        in_specs=(P(axis), P()), out_specs=P())(stacked_params, xm)
+    return out.reshape((B,) + x.shape[1:])
+
+
+class PipelinedTransformerLM:
+    """Minimal pipelined model: replicated embedding + N pipelined
+    ``TransformerEncoderBlock``s + replicated head, trained with one
+    jitted step over the pipe mesh.  The demonstration vehicle for the
+    'pipe' axis (a production run composes axes: data x pipe x model)."""
+
+    def __init__(self, vocab_size: int, d_model: int, n_blocks: int,
+                 n_heads: int, d_ff: int, seq_len: int, n_classes: int,
+                 mesh: Mesh, n_micro: int = 4, lr: float = 1e-3,
+                 seed: int = 0):
+        from deeplearning4j_tpu.nn.conf.layers_transformer import (
+            EmbeddingSequenceLayer, TransformerEncoderBlock)
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        self.mesh, self.n_micro = mesh, n_micro
+        self.block_conf = TransformerEncoderBlock(
+            n_heads=n_heads, d_ff=d_ff, use_flash=False)
+        self.block_conf.infer_shapes((seq_len, d_model))
+        emb = EmbeddingSequenceLayer(n_in=vocab_size, n_out=d_model,
+                                     max_len=seq_len)
+        emb.infer_shapes((seq_len,))
+        self.emb_conf = emb
+        k = jax.random.key(seed)
+        k_emb, k_blocks, k_head = jax.random.split(k, 3)
+        emb_params, _ = emb.init(k_emb)
+        head_w = 0.02 * jax.random.normal(k_head, (d_model, n_classes))
+        self.params = {
+            "emb": emb_params,
+            "blocks": stack_block_params(self.block_conf, n_blocks,
+                                         k_blocks),
+            "head": {"W": head_w,
+                     "b": jnp.zeros((n_classes,), jnp.float32)},
+        }
+        # place params on the pipe axis BEFORE building optimizer state:
+        # zeros_like then inherits the shardings, so Adam's m/v for the
+        # stacked blocks are born sharded (the memory PP exists for)
+        spec = jax.tree_util.tree_map(lambda a: P(), self.params)
+        spec["blocks"] = jax.tree_util.tree_map(
+            lambda a: P("pipe"), self.params["blocks"])
+        self.params = jax.device_put(
+            self.params, jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), spec))
+        self._updater = Adam(learning_rate=lr)
+        self.opt_state = self._updater.init_state(self.params)
+        block_conf, emb_conf = self.block_conf, self.emb_conf
+        n_mi = n_micro
+        msh = mesh
+
+        def forward(params, ids):
+            h, _ = emb_conf.apply(params["emb"], {}, ids,
+                                  training=False)
+            h = gpipe_apply(
+                msh, params["blocks"], h,
+                lambda p, a: block_conf.apply(p, {}, a,
+                                              training=False)[0],
+                n_mi)
+            pooled = jnp.mean(h, axis=1)
+            return pooled @ params["head"]["W"] + params["head"]["b"]
+
+        def loss_fn(params, ids, labels):
+            logits = forward(params, ids)
+            lp = jax.nn.log_softmax(logits, -1)
+            return -jnp.mean(jnp.sum(labels * lp, -1))
+
+        def step(params, opt_state, ids, labels, it):
+            loss, grads = jax.value_and_grad(loss_fn)(params, ids,
+                                                      labels)
+            updates, opt_state = self._updater.update(grads, opt_state,
+                                                      params, it)
+            params = jax.tree_util.tree_map(lambda p, u: p - u, params,
+                                            updates)
+            opt_state = self._updater.finalize(opt_state, params)
+            return params, opt_state, loss
+
+        self._forward = jax.jit(forward)
+        self._step = jax.jit(step)
+        self._it = 0
+
+    def fit_batch(self, ids, labels):
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, jnp.asarray(ids),
+            jnp.asarray(labels), self._it)
+        self._it += 1
+        return float(loss)
+
+    def predict(self, ids):
+        return np.asarray(self._forward(self.params, jnp.asarray(ids)))

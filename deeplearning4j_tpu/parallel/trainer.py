@@ -29,34 +29,55 @@ from deeplearning4j_tpu.parallel.mesh import MeshConfig
 log = logging.getLogger("deeplearning4j_tpu")
 
 
-def _tp_shardable_layers(model) -> set:
-    """Layer/vertex names whose Dense 'W' kernels are safe to shard
-    column-wise (Megatron-style).  Recurrent fused-gate kernels ([in, 4h]
-    — gate slices would cross shard boundaries) and conv HWIO kernels are
-    EXCLUDED: they replicate, DP still shards their gradients' batch."""
+def _tp_shardable_layers(model) -> dict:
+    """Per-layer tensor-parallel sharding rules: name -> {param: kind}
+    with kind 'col' (P(None, 'model')) or 'row' (P('model', None)) —
+    Megatron-style.  Dense 'W' shards column-wise; transformer blocks
+    shard Wqkv/W1 column-wise and W2/Wo row-wise.  The FFN half gets
+    the classic column-then-row pairing (one psum); the attention half
+    shards Wqkv contiguously, which crosses the fused q/k/v slice
+    boundaries — GSPMD keeps the math exact but regathers the qkv
+    activation before the head split, so the attention half buys
+    memory sharding at the cost of one extra activation gather (true
+    Megatron interleaves per-head [q_h|k_h|v_h] kernel columns).
+    Sequence embeddings shard over the vocab rows.  Recurrent
+    fused-gate kernels ([in, 4h] — gate slices would cross shard
+    boundaries) and conv HWIO kernels are EXCLUDED: they replicate, DP
+    still shards their gradients' batch."""
     from deeplearning4j_tpu.nn.conf.layers_core import DenseLayer
-    names = set()
+    from deeplearning4j_tpu.nn.conf.layers_transformer import (
+        EmbeddingSequenceLayer, TransformerEncoderBlock)
+    rules = {}
     if hasattr(model, "layers"):
         items = ((f"layer_{i}", ly) for i, ly in enumerate(model.layers))
     else:
         items = ((n, s.layer) for n, s in model.conf.vertices.items()
                  if s.layer is not None)
     for name, ly in items:
-        if isinstance(ly, DenseLayer) and not getattr(ly, "IS_RNN", False):
-            names.add(name)
-    return names
+        if isinstance(ly, TransformerEncoderBlock):
+            rules[name] = {"Wqkv": "col", "W1": "col",
+                           "W2": "row", "Wo": "row"}
+        elif isinstance(ly, EmbeddingSequenceLayer):
+            rules[name] = {"W": "row"}
+        elif isinstance(ly, DenseLayer) and not getattr(ly, "IS_RNN",
+                                                        False):
+            rules[name] = {"W": "col"}
+    return rules
 
 
-def _param_spec(path, shape, tp: int, shardable: set):
+def _param_spec(path, shape, tp: int, shardable: dict):
     """Sharding rule for one parameter leaf under tensor parallelism.
     `path` is a tree path whose second-to-last key is the owning
     layer/vertex name (works for both the params tree and optimizer-state
     trees that mirror it one level deeper)."""
     keys = [getattr(p, "key", str(p)) for p in path]
     layer = keys[-2] if len(keys) >= 2 else None
-    if (tp > 1 and len(shape) == 2 and keys and keys[-1] == "W"
-            and layer in shardable and shape[-1] % tp == 0):
-        return P(None, "model")
+    kind = shardable.get(layer, {}).get(keys[-1]) if keys else None
+    if tp > 1 and kind and len(shape) == 2:
+        if kind == "col" and shape[-1] % tp == 0:
+            return P(None, "model")
+        if kind == "row" and shape[0] % tp == 0:
+            return P("model", None)
     return P()
 
 

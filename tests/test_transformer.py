@@ -149,3 +149,28 @@ def test_bert_causal_block():
     y2, _ = blk.apply(params, state, jnp.asarray(x2), training=False)
     np.testing.assert_allclose(np.asarray(y1)[:, :5],
                                np.asarray(y2)[:, :5], atol=1e-5)
+
+
+def test_bert_tensor_parallel_matches_single_device():
+    """DP x TP sharding of the transformer block (Wqkv/W1 col, W2/Wo
+    row, embedding vocab-row) must not change the math."""
+    from deeplearning4j_tpu.parallel.mesh import MeshConfig
+    from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (8, 8)).astype(np.int32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+
+    def run(mesh_conf):
+        m = Bert(n_layers=2, d_model=32, n_heads=4, d_ff=64,
+                 vocab_size=64, seq_len=8, max_len=16,
+                 compute_dtype=None, seed=11)
+        m.updater = Adam(learning_rate=1e-3)
+        net = m.init_graph()
+        tr = ShardedTrainer(net, mesh_conf)
+        return [float(tr.fit_batch(ids, y)) for _ in range(4)]
+
+    single = run(MeshConfig(data=1, model=1))
+    tp = run(MeshConfig(data=2, model=4))
+    np.testing.assert_allclose(tp, single, rtol=2e-4)
