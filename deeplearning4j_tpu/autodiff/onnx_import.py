@@ -72,6 +72,11 @@ class _OnnxImporter:
         self.trainable_consts = trainable_consts
         self.tensors: Dict[str, SDVariable] = {}
         self.const_values: Dict[str, np.ndarray] = {}
+        self.opset = max(
+            (int(o.get("version", 0))
+             for o in model.get("opset_import", [])
+             if o.get("domain", "") in ("", "ai.onnx")),
+            default=13)
 
     def _resolve(self, ref: str) -> SDVariable:
         v = self.tensors.get(ref)
@@ -153,7 +158,9 @@ class _OnnxImporter:
         if op == "Gemm":
             alpha, beta = a.get("alpha", 1.0), a.get("beta", 1.0)
             out = node["output"][0]
-            has_c = len(ins) > 2
+            # an omitted optional C arrives as the empty-string
+            # input, which resolves to None (advisor r3)
+            has_c = len(ins) > 2 and ins[2] is not None
             mm_out = out if (alpha == 1.0 and not has_c) else out + "/mm"
             self._emit_named("matmul", [ins[0].name, ins[1].name],
                              mm_out,
@@ -205,8 +212,14 @@ class _OnnxImporter:
             return self._emit(node, "broadcast_to", ins[:1],
                               shape=[int(s) for s in shape])
         if op == "Softmax":
-            return self._emit(node, "softmax", ins,
-                              axis=a.get("axis", -1))
+            # Opset>=13: elementwise softmax over `axis` (default -1).
+            # Pre-13: default axis=1 with flatten-to-2D semantics
+            # (advisor r3 — opset_import was parsed but never consulted).
+            if self.opset >= 13:
+                return self._emit(node, "softmax", ins,
+                                  axis=a.get("axis", -1))
+            return self._emit(node, "softmax_onnx_pre13", ins,
+                              axis=a.get("axis", 1))
         if op == "LeakyRelu":
             return self._emit(node, "leaky_relu", ins,
                               alpha=a.get("alpha", 0.01))

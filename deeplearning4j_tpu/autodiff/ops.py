@@ -945,9 +945,25 @@ def _flatten_onnx(x, axis=1):
 
 @register_op("unsqueeze_onnx")
 def _unsqueeze_onnx(x, axis):
-    for a in sorted(int(v) for v in np.asarray(axis).reshape(-1)):
+    # ONNX Unsqueeze axes are relative to the OUTPUT rank; normalize
+    # negatives against ndim+len(axes) before inserting in ascending
+    # order (axes=[-1,-3] on (2,3) -> (2,1,3,1), not (1,2,3,1)).
+    axes = [int(v) for v in np.asarray(axis).reshape(-1)]
+    out_rank = x.ndim + len(axes)
+    norm = sorted(a + out_rank if a < 0 else a for a in axes)
+    for a in norm:
         x = jnp.expand_dims(x, a)
     return x
+
+
+@register_op("softmax_onnx_pre13")
+def _softmax_onnx_pre13(x, axis=1):
+    # Opset<13 ONNX Softmax: coerce to 2-D at `axis`, softmax over the
+    # flattened trailing block, restore shape.
+    axis = int(axis) % max(1, x.ndim)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    flat = jnp.reshape(x, (lead, -1))
+    return jnp.reshape(jax.nn.softmax(flat, axis=-1), x.shape)
 
 
 @register_op("clip_scalar")

@@ -48,6 +48,7 @@ class _AsyncActorLearner:
 
     def _init_shared(self):
         self._lock = threading.Lock()
+        self._step_lock = threading.Lock()  # cheap, never held with _lock
         self.step_count = 0
         self.episode_rewards: List[float] = []
 
@@ -109,7 +110,8 @@ class _AsyncActorLearner:
                 obs = boot_obs = obs2
                 ep_reward += r
                 ep_steps += 1
-                self.step_count += 1
+                with self._step_lock:  # += is a lost-update race
+                    self.step_count += 1
                 if done or ep_steps >= c.max_epoch_step:
                     with self._lock:
                         self.episode_rewards.append(ep_reward)

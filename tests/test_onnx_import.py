@@ -259,3 +259,62 @@ def test_onnx_unknown_op_fails_loudly():
                 [O.value_info("y", (2, 2))], [])
     with pytest.raises(NotImplementedError, match="TotallyMadeUp"):
         import_onnx_model(m)
+
+
+def test_gemm_omitted_c_as_empty_string_input():
+    """ONNX encodes an omitted optional C as the empty-string input;
+    Gemm must treat that as 'no C' (advisor r3)."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    w = rng.normal(size=(6, 5)).astype(np.float32)
+    m = O.model([O.node("Gemm", ["x", "w", ""], ["out"],
+                        alpha=1.0, beta=1.0, transA=0, transB=0)],
+                [O.value_info("x", (4, 6))],
+                [O.value_info("out", (4, 5))],
+                [O.tensor("w", w)])
+    sd = import_onnx_model(m)
+    got = np.asarray(sd.output({"x": x}, ["out"])["out"])
+    np.testing.assert_allclose(got, x @ w, atol=1e-5)
+
+
+def test_unsqueeze_negative_axes_are_output_rank_relative():
+    """axes=[-1,-3] on (2,3) -> (2,1,3,1), NOT sequential insertion
+    against intermediate ranks (advisor r3)."""
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(2, 3)).astype(np.float32)
+    m = O.model([O.node("Unsqueeze", ["x"], ["out"], axes=[-1, -3])],
+                [O.value_info("x", (2, 3))],
+                [O.value_info("out", (2, 1, 3, 1))], [],
+                opset_version=11)
+    sd = import_onnx_model(m)
+    got = np.asarray(sd.output({"x": x}, ["out"])["out"])
+    assert got.shape == (2, 1, 3, 1)
+    np.testing.assert_allclose(got, x[:, None, :, None], atol=0)
+
+
+def test_softmax_pre13_flatten_semantics():
+    """Opset<13 Softmax defaults to axis=1 with flatten-to-2D
+    semantics; opset>=13 is elementwise over axis=-1 (advisor r3)."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+
+    def np_softmax(a, axis):
+        e = np.exp(a - a.max(axis=axis, keepdims=True))
+        return e / e.sum(axis=axis, keepdims=True)
+
+    m_old = O.model([O.node("Softmax", ["x"], ["out"])],
+                    [O.value_info("x", (2, 3, 4))],
+                    [O.value_info("out", (2, 3, 4))], [],
+                    opset_version=11)
+    got_old = np.asarray(import_onnx_model(m_old)
+                         .output({"x": x}, ["out"])["out"])
+    exp_old = np_softmax(x.reshape(2, 12), -1).reshape(2, 3, 4)
+    np.testing.assert_allclose(got_old, exp_old, atol=1e-5)
+
+    m_new = O.model([O.node("Softmax", ["x"], ["out"])],
+                    [O.value_info("x", (2, 3, 4))],
+                    [O.value_info("out", (2, 3, 4))], [],
+                    opset_version=17)
+    got_new = np.asarray(import_onnx_model(m_new)
+                         .output({"x": x}, ["out"])["out"])
+    np.testing.assert_allclose(got_new, np_softmax(x, -1), atol=1e-5)
