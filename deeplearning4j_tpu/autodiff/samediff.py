@@ -129,6 +129,13 @@ class TrainingConfig:
     l2: float = 0.0
     data_set_feature_mapping: Sequence[str] = ()
     data_set_label_mapping: Sequence[str] = ()
+    # Mixed-precision policy for the TRAINING path only ("bfloat16" =
+    # AMP: f32 master weights, float leaves cast to bf16 at graph entry,
+    # loss accumulated f32; grads come back f32 through the cast).  The
+    # reference has no AMP (fp32-only cuDNN helper path) — this is a
+    # TPU-first capability, required to keep imported-graph fine-tunes
+    # on the MXU's bf16 path.  output()/golden parity are unaffected.
+    compute_dtype: Optional[str] = None
 
     def resolved_updater(self) -> BaseUpdater:
         u = self.updater
@@ -243,13 +250,26 @@ class SameDiff:
     # Execution (trace-to-XLA — replaces InferenceSession's interpreter)
     # ------------------------------------------------------------------
     def _run_graph(self, param_vals: Dict[str, Any],
-                   feed_vals: Dict[str, Any], needed: set) -> Dict[str, Any]:
+                   feed_vals: Dict[str, Any], needed: set,
+                   compute_dtype: Optional[str] = None) -> Dict[str, Any]:
+        if compute_dtype is None:
+            cast = lambda v: v
+        else:
+            cd = jnp.dtype(compute_dtype)
+
+            def cast(v):
+                # only float leaves move; ids/masks/bools stay put
+                dt = np.asarray(v).dtype if not hasattr(v, "dtype") \
+                    else v.dtype
+                if np.issubdtype(dt, np.floating):
+                    return jnp.asarray(v, cd)
+                return v
         env: Dict[str, Any] = {}
         for k, v in self.values.items():
             if self.vars[k].var_type == "CONSTANT":
-                env[k] = v  # host value: participates in constant folding
-        env.update(param_vals)
-        env.update(feed_vals)
+                env[k] = cast(v) if compute_dtype else v
+        env.update({k: cast(v) for k, v in param_vals.items()})
+        env.update({k: cast(v) for k, v in feed_vals.items()})
         for node in self.ops:
             if not any(o in needed for o in node.outputs):
                 continue
@@ -381,17 +401,19 @@ class SameDiff:
     # ------------------------------------------------------------------
     # Gradients (jax.grad over the traced loss — no gradient graph)
     # ------------------------------------------------------------------
-    def _loss_fn(self, feeds_keys, l2=0.0):
+    def _loss_fn(self, feeds_keys, l2=0.0, compute_dtype=None):
         losses = self.loss_variables
         if not losses:
             raise ValueError("set_loss_variables(...) first")
         needed = self._needed_for(losses)
 
         def fn(params, feeds):
-            env = self._run_graph(params, feeds, needed)
+            env = self._run_graph(params, feeds, needed,
+                                  compute_dtype=compute_dtype)
             total = 0.0
             for name in losses:
-                total = total + jnp.mean(env[name])
+                total = total + jnp.mean(
+                    jnp.asarray(env[name], jnp.float32))
             if l2:
                 for v in params.values():
                     total = total + 0.5 * l2 * jnp.sum(jnp.square(v))
@@ -424,7 +446,8 @@ class SameDiff:
     def _train_step_fn(self, feed_names):
         cfg = self.training_config
         updater = cfg.resolved_updater()
-        loss_fn = self._loss_fn(feed_names, l2=cfg.l2)
+        loss_fn = self._loss_fn(feed_names, l2=cfg.l2,
+                                compute_dtype=cfg.compute_dtype)
 
         def step(params, opt_state, step_idx, feeds):
             loss, grads = jax.value_and_grad(loss_fn)(params, feeds)

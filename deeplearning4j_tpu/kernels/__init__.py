@@ -4,7 +4,8 @@ with hand-optimized CUDA helpers (``libnd4j/.../helpers/cuda``), except
 each kernel here is a few dozen lines of Python lowered through Mosaic.
 """
 from deeplearning4j_tpu.kernels.flash_attention import (
-    attention, flash_attention, mask_to_bias, xla_attention)
+    attention, flash_attention, mask_to_bias, reset_route_log, route_log,
+    xla_attention)
 
 __all__ = ["attention", "flash_attention", "mask_to_bias",
-           "xla_attention"]
+           "reset_route_log", "route_log", "xla_attention"]

@@ -1,0 +1,174 @@
+"""KV-cache incremental decoding — the transformer analogue of DL4J's
+``rnnTimeStep`` (``MultiLayerNetwork.rnnTimeStep`` keeps per-layer
+recurrent state between calls; here the state is each block's key/value
+cache).
+
+TPU-first design: generation is ONE jitted ``lax.scan`` over time with
+static shapes — the KV caches are preallocated [b, h, max_len, dh]
+buffers written via ``lax.dynamic_update_slice``, prompt prefill and
+sampling ride the same scan (a step consumes the prompt token while
+``t < len(prompt)``, its own sample after) — so the whole decode is a
+single XLA program, no per-token Python dispatch or retrace.
+
+Works over any MultiLayerNetwork whose stack is
+``EmbeddingSequenceLayer -> N x TransformerEncoderBlock(causal=True)
+-> (Rnn)OutputLayer`` (e.g. ``zoo.Gpt``).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.layers_recurrent import RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.layers_transformer import (
+    EmbeddingSequenceLayer, TransformerEncoderBlock, _layer_norm)
+
+
+def _embed_token(ly: EmbeddingSequenceLayer, params, tok, pos):
+    """[b] int token at scalar position -> [b, d]."""
+    y = jnp.take(params["W"], tok.astype(jnp.int32), axis=0)
+    if ly.add_positional:
+        y = y + jax.lax.dynamic_slice_in_dim(
+            params["P"], pos, 1, axis=0)[0]
+    if ly.layer_norm:
+        y = _layer_norm(y, params["g"], params["b"], ly.eps)
+    return y
+
+
+def _block_decode_step(ly: TransformerEncoderBlock, params, kcache,
+                       vcache, x, pos):
+    """One cached decoder step.  x: [b, d] new-token hidden; caches
+    [b, h, L, dh]; writes position ``pos``, attends over <= pos.
+    Returns (y [b, d], kcache, vcache)."""
+    b, d = x.shape
+    h, dh = ly.n_heads, d // ly.n_heads
+    L = kcache.shape[2]
+    cast = lambda w: w.astype(x.dtype)
+
+    qkv = x @ cast(params["Wqkv"]) + cast(params["bqkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split = lambda z: z.reshape(b, h, 1, dh)
+    q, k, v = split(q), split(k), split(v)
+    kcache = jax.lax.dynamic_update_slice(kcache, k, (0, 0, pos, 0))
+    vcache = jax.lax.dynamic_update_slice(vcache, v, (0, 0, pos, 0))
+
+    scale = 1.0 / (dh ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kcache).astype(jnp.float32)
+    s = s * scale
+    valid = jnp.arange(L) <= pos                      # causal: <= pos
+    s = jnp.where(valid[None, None, None, :], s, -1e9)
+    p = jax.nn.softmax(s, axis=-1).astype(vcache.dtype)
+    att = jnp.einsum("bhqk,bhkd->bhqd", p, vcache)
+    att = att.transpose(0, 2, 1, 3).reshape(b, d)
+    att = att @ cast(params["Wo"]) + cast(params["bo"])
+    hdn = _layer_norm(x + att, params["ln1_g"], params["ln1_b"], ly.eps)
+
+    from deeplearning4j_tpu.nn.activations import get_activation
+    act = get_activation(ly.activation or "gelu")
+    ffn = act(hdn @ cast(params["W1"]) + cast(params["b1"]))
+    ffn = ffn @ cast(params["W2"]) + cast(params["b2"])
+    y = _layer_norm(hdn + ffn, params["ln2_g"], params["ln2_b"], ly.eps)
+    return y, kcache, vcache
+
+
+class TransformerGenerator:
+    """Greedy / temperature sampling with KV caches over a decoder MLN.
+
+    >>> gen = TransformerGenerator(net)
+    >>> out = gen.generate(prompt_ids, n_new=64)      # [b, t0+64]
+    """
+
+    def __init__(self, net, compute_dtype: Optional[str] = None):
+        layers = list(net.layers)
+        if not isinstance(layers[0], EmbeddingSequenceLayer):
+            raise ValueError("generator expects EmbeddingSequenceLayer "
+                             f"first, got {type(layers[0]).__name__}")
+        if not all(isinstance(l, TransformerEncoderBlock)
+                   for l in layers[1:-1]):
+            raise ValueError("generator expects a pure "
+                             "TransformerEncoderBlock stack")
+        for l in layers[1:-1]:
+            if not l.causal:
+                raise ValueError("generation requires causal=True blocks")
+        self.net = net
+        self.emb = layers[0]
+        self.blocks = layers[1:-1]
+        self.head = layers[-1]
+        if not isinstance(self.head, RnnOutputLayer):
+            raise ValueError("generator expects an RnnOutputLayer head")
+        self.compute_dtype = (jnp.dtype(compute_dtype)
+                              if compute_dtype else jnp.float32)
+        self._fn_cache = {}
+
+    def _params(self):
+        pt = self.net.params_tree
+        n = len(self.net.layers)
+        return (pt["layer_0"],
+                [pt[f"layer_{i}"] for i in range(1, n - 1)],
+                pt[f"layer_{n - 1}"])
+
+    def _step(self, emb_p, blk_ps, head_p, caches, tok, pos):
+        x = _embed_token(self.emb, emb_p, tok, pos)
+        x = x.astype(self.compute_dtype)
+        new_caches = []
+        for ly, p, (kc, vc) in zip(self.blocks, blk_ps, caches):
+            x, kc, vc = _block_decode_step(ly, p, kc, vc, x, pos)
+            new_caches.append((kc, vc))
+        logits = (x.astype(jnp.float32) @ head_p["W"] + head_p["b"])
+        return logits, new_caches
+
+    def generate(self, prompt_ids, n_new: int, temperature: float = 0.0,
+                 seed: int = 0, max_len: Optional[int] = None):
+        """[b, t0] int prompt -> [b, t0 + n_new].  temperature == 0 is
+        greedy argmax; > 0 samples logits/temperature."""
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        b, t0 = prompt_ids.shape
+        total = t0 + n_new
+        L = max_len or total
+        if L < total:
+            raise ValueError(f"max_len {L} < prompt+new {total}")
+        key = (b, t0, n_new, L, float(temperature))
+        if key not in self._fn_cache:
+            self._fn_cache[key] = jax.jit(
+                lambda e, bl, h, ids, k: self._generate_scan(
+                    e, bl, h, ids, k, t0, n_new, L, temperature))
+        emb_p, blk_ps, head_p = self._params()
+        ids = jnp.concatenate(
+            [prompt_ids, jnp.zeros((b, n_new), jnp.int32)], axis=1)
+        out = self._fn_cache[key](emb_p, blk_ps, head_p, ids,
+                                  jax.random.PRNGKey(seed))
+        return np.asarray(out)
+
+    def _generate_scan(self, emb_p, blk_ps, head_p, ids, rng_key,
+                       t0, n_new, L, temperature):
+        b = ids.shape[0]
+        dh = self.emb.n_out // self.blocks[0].n_heads
+        h = self.blocks[0].n_heads
+        caches = [(jnp.zeros((b, h, L, dh), self.compute_dtype),
+                   jnp.zeros((b, h, L, dh), self.compute_dtype))
+                  for _ in self.blocks]
+
+        def body(carry, pos):
+            ids, caches, key = carry
+            tok = jax.lax.dynamic_slice_in_dim(ids, pos, 1, axis=1)[:, 0]
+            logits, caches = self._step(emb_p, blk_ps, head_p, caches,
+                                        tok, pos)
+            if temperature > 0.0:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(
+                    sub, logits / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            nxt = nxt.astype(jnp.int32)
+            # keep the prompt: only write positions >= t0
+            cur = jax.lax.dynamic_slice_in_dim(ids, pos + 1, 1, axis=1)
+            write = jnp.where(pos + 1 >= t0, nxt[:, None], cur)
+            ids = jax.lax.dynamic_update_slice(ids, write, (0, pos + 1))
+            return (ids, caches, key), None
+
+        (ids, _, _), _ = jax.lax.scan(
+            body, (ids, caches, rng_key), jnp.arange(t0 + n_new - 1))
+        return ids

@@ -1,0 +1,88 @@
+"""BERT-base import fixture (BASELINE config 4's model artifact).
+
+Generates — once, cached under ``DL4J_TPU_FIXTURE_CACHE`` (default
+/tmp/deeplearning4j_tpu_fixtures) — a BERT-base-sized (12x768, 30522
+vocab, ~110M param, ~438 MB) random-init FROZEN TF graph plus TF-run
+goldens, using the installed tensorflow/transformers.  Far too large to
+commit: the ``dl4j-test-resources`` external-artifact pattern
+[UNVERIFIED ref: dl4j-test-resources repo].  Shared by
+``tests/test_bert_base_import.py`` and ``bench.py`` (the imported-graph
+fine-tune benchmark) so both exercise the SAME artifact.
+"""
+import os
+import subprocess
+import sys
+
+CACHE = os.environ.get("DL4J_TPU_FIXTURE_CACHE",
+                       "/tmp/deeplearning4j_tpu_fixtures")
+
+_GEN = r"""
+import os
+os.environ["CUDA_VISIBLE_DEVICES"] = ""
+import numpy as np
+import tensorflow as tf
+from transformers import BertConfig, TFBertModel
+from tensorflow.python.framework.convert_to_constants import (
+    convert_variables_to_constants_v2)
+cfg = BertConfig()          # BERT-base defaults
+tf.random.set_seed(0)
+model = TFBertModel(cfg)
+B, T = 2, {t}
+ids = np.random.default_rng(0).integers(
+    0, cfg.vocab_size, (B, T)).astype(np.int32)
+mask = np.ones((B, T), np.int32); mask[1, T // 2:] = 0
+tt = np.zeros((B, T), np.int32)
+out = model(input_ids=ids, attention_mask=mask, token_type_ids=tt)
+def call(i, m, t):
+    return model(input_ids=i, attention_mask=m, token_type_ids=t)
+conc = tf.function(call).get_concrete_function(
+    tf.TensorSpec((None, T), tf.int32), tf.TensorSpec((None, T), tf.int32),
+    tf.TensorSpec((None, T), tf.int32))
+frozen = convert_variables_to_constants_v2(conc)
+with open({pb!r}, "wb") as f:
+    f.write(frozen.graph.as_graph_def().SerializeToString())
+np.savez({gold!r}, ids=ids, mask=mask, tt=tt,
+         last_hidden=out.last_hidden_state.numpy(),
+         pooler=out.pooler_output.numpy())
+print("GEN_OK")
+"""
+
+
+def attach_classifier_head(sd, n_classes: int = 2, seed: int = 0):
+    """Idempotently attach pooled-output -> n-class head + CE loss to an
+    imported BERT graph (the SST-2 fine-tune head of BASELINE config 4).
+    Expects the frozen graph's pooler output at ``Identity_1``."""
+    import numpy as np
+    if "loss" in sd.vars:
+        return
+    pooled = sd.vars["Identity_1"]
+    w = sd.var("cls_W", np.random.default_rng(seed).normal(
+        scale=0.02, size=(768, n_classes)).astype(np.float32))
+    b = sd.var("cls_b", np.zeros(n_classes, np.float32))
+    logits = sd.op("add", sd.matmul(pooled, w), b, name="logits")
+    labels = sd.placeholder("labels", (None,), "int32")
+    per_ex = sd.op("sparse_softmax_cross_entropy_with_logits", labels,
+                   logits)
+    loss = sd.reduce_mean(per_ex, name="loss")
+    sd.set_loss_variables(loss)
+
+
+def fixture_paths(t: int = 512):
+    pb = os.path.join(CACHE, f"bert_base_frozen_t{t}.pb")
+    gold = os.path.join(CACHE, f"bert_base_golden_t{t}.npz")
+    return pb, gold
+
+
+def ensure_bert_base_fixture(t: int = 512):
+    """Returns (frozen_pb_path, golden_npz_path), generating on first
+    call (~3 min: a TF CPU forward at [2, t] plus freezing)."""
+    pb, gold = fixture_paths(t)
+    if not (os.path.exists(pb) and os.path.exists(gold)):
+        os.makedirs(CACHE, exist_ok=True)
+        code = _GEN.format(pb=pb, gold=gold, t=t)
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, timeout=1800)
+        if b"GEN_OK" not in r.stdout:
+            raise RuntimeError("fixture generation failed: "
+                               + r.stderr.decode()[-2000:])
+    return pb, gold

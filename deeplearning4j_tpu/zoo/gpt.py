@@ -1,0 +1,76 @@
+"""GPT-style causal decoder LM — the causal-attention flagship.
+
+The reference's generative config is the GravesLSTM char-RNN
+(dl4j-examples ``LSTMCharModellingExample``); its transformer era never
+shipped a decoder.  This is the TPU-native generative flagship: the
+same `TransformerEncoderBlock` stack as zoo.Bert with ``causal=True``
+(the Pallas flash kernel's causal path — block-skipped lower triangle,
+O(t) memory) and a per-position `RnnOutputLayer` LM head with SPARSE
+integer labels (a [b, t, 30k] one-hot label tensor at t=2048 would be
+0.5 GB/batch).  ``bench.py`` benches it at t=2048; incremental
+generation (the transformer ``rnnTimeStep`` analogue) lives in
+``models/generation.py``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers_recurrent import RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.layers_transformer import (
+    EmbeddingSequenceLayer, TransformerEncoderBlock)
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+@dataclasses.dataclass
+class Gpt(ZooModel):
+    """Decoder-only causal LM.  ``Gpt()`` is GPT-2-small-shaped
+    (12 x 768, 12 heads, ff 3072); shrink for tests."""
+
+    vocab_size: int = 32000
+    max_len: int = 2048
+    d_model: int = 768
+    n_layers: int = 12
+    # TPU-first default: 6 heads of d_head=128 — the MXU contracts 128
+    # lanes per pass, so 64-dim heads run the attention matmuls at half
+    # rate (measured: 50.2% vs 38.1% MFU at b=8/t=2048, see
+    # FLASH_SWEEP_r04.json).  GPT-2's 12x64 layout is one arg away.
+    n_heads: int = 6
+    d_ff: int = 3072
+    dropout: float = 0.0
+    seq_len: int = 2048           # training sequence length
+    compute_dtype: Optional[str] = "bfloat16"
+    use_flash: bool = True
+    updater: object = None
+
+    def conf(self):
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(learning_rate=3e-4)))
+        if self.compute_dtype:
+            b = b.compute_dtype(self.compute_dtype)
+        lst = (b.list()
+               .set_input_type(InputType.feed_forward(self.seq_len))
+               .layer(EmbeddingSequenceLayer(
+                   n_in=self.vocab_size, n_out=self.d_model,
+                   max_len=self.max_len, dropout=self.dropout or None)))
+        for _ in range(self.n_layers):
+            lst = lst.layer(TransformerEncoderBlock(
+                n_heads=self.n_heads, d_ff=self.d_ff, causal=True,
+                dropout=self.dropout or None, use_flash=self.use_flash))
+        return (lst
+                .layer(RnnOutputLayer(n_out=self.vocab_size,
+                                      activation="softmax",
+                                      loss="sparse_mcxent"))
+                .build())
+
+    def flops_per_token_train(self) -> float:
+        """Analytic fwd+bwd FLOPs/token (6 per matmul param + causal
+        attention at half the full-attention score/context cost)."""
+        d, ff, L, t = self.d_model, self.d_ff, self.n_layers, self.seq_len
+        matmul_params = L * (4 * d * d + 2 * d * ff)
+        lm_head = d * self.vocab_size
+        return 6.0 * (matmul_params + lm_head) + 6.0 * L * t * d

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""BASELINE config 4 — TF BERT import + SST-2-style fine-tune
+(``SameDiff`` TF import path): load a frozen pb, rewrite attention
+subgraphs onto the Pallas flash kernel, attach a 2-class head, and
+fine-tune in bf16 AMP.
+
+--smoke uses the committed 2-layer tiny-BERT fixture; full mode
+generates/caches the ~438 MB BERT-base fixture and mirrors the
+``bench.py`` imported-fine-tune benchmark."""
+import os
+
+import numpy as np
+
+from _common import example_args, setup_platform
+
+
+def main():
+    args = example_args(__doc__)
+    setup_platform(args.smoke)
+
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.autodiff.rewrites import fuse_attention
+    from deeplearning4j_tpu.autodiff.tf_import import import_frozen_pb
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    if args.smoke:
+        pb = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          os.pardir, "tests", "fixtures",
+                          "bert_tiny_frozen.pb")
+        t, n_expect = 16, 2
+    else:
+        from deeplearning4j_tpu.utils.bert_fixture import (
+            ensure_bert_base_fixture)
+        pb, _ = ensure_bert_base_fixture(t=512)
+        t, n_expect = 512, 12
+
+    sd = import_frozen_pb(pb)
+    n_fused = fuse_attention(sd)
+    print(f"fused {n_fused} attention sites")
+    assert n_fused == n_expect, n_fused
+
+    d_model = 64 if args.smoke else 768
+    feeds = ["i", "m", "t"]
+    pooled = sd.vars["Identity_1"]
+
+    w = sd.var("cls_W", np.random.default_rng(0).normal(
+        scale=0.02, size=(d_model, 2)).astype(np.float32))
+    b = sd.var("cls_b", np.zeros(2, np.float32))
+    logits = sd.op("add", sd.matmul(pooled, w), b, name="logits")
+    labels = sd.placeholder("labels", (None,), "int32")
+    per_ex = sd.op("sparse_softmax_cross_entropy_with_logits", labels,
+                   logits)
+    sd.set_loss_variables(sd.reduce_mean(per_ex, name="loss"))
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(learning_rate=2e-5),
+        data_set_feature_mapping=feeds,
+        data_set_label_mapping=["labels"],
+        compute_dtype="bfloat16"))
+
+    rng = np.random.default_rng(0)
+    batch = 4 if args.smoke else 32
+    ids = rng.integers(0, 500, (batch, t)).astype(np.int32)
+    lab = rng.integers(0, 2, batch).astype(np.int32)
+    mask = np.ones((batch, t), np.int32)
+    tt = np.zeros((batch, t), np.int32)
+    ds = MultiDataSet([ids, mask, tt], [lab])
+    losses = sd.fit([ds] * (2 if args.smoke else 10), n_epochs=1)
+    print(f"loss {losses[0]:.4f} -> {losses[-1]:.4f}")
+    assert np.isfinite(losses).all()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""BASELINE config 3 — GravesLSTM char-RNN language model
+(dl4j-examples ``LSTMCharModellingExample``): CharacterIterator +
+TextGenerationLSTM + temperature sampling."""
+from _common import example_args, setup_platform
+
+
+def main():
+    args = example_args(__doc__)
+    setup_platform(args.smoke)
+
+    from deeplearning4j_tpu.data.char_iterator import (
+        CharacterIterator, sample_characters)
+    from deeplearning4j_tpu.zoo import TextGenerationLSTM
+
+    text = ("the quick brown fox jumps over the lazy dog. " * 200)
+    seq, hidden, epochs = ((30, 64, 4) if args.smoke else (64, 256, 30))
+    it = CharacterIterator(text, seq_length=seq, batch=16, seed=1)
+    model = TextGenerationLSTM(vocab_size=it.vocab_size, hidden=hidden,
+                               n_layers=2, tbptt_length=seq // 2,
+                               seed=5).init_graph()
+    first = model.fit(it, n_epochs=1, async_prefetch=False)
+    last = first
+    for _ in range(epochs - 1):
+        last = model.fit(it, n_epochs=1, async_prefetch=False)
+    sample = sample_characters(model, it, init="the ", n_chars=120,
+                               temperature=0.6)
+    print(f"loss {first:.3f} -> {last:.3f}")
+    print(f"sample: {sample!r}")
+    assert last < first
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

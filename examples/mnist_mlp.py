@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""BASELINE config 1 — MNIST MLP (dl4j-examples
+``MLPMnistSingleLayerExample``): 784 -> 500(relu) -> 10(softmax,NLL),
+Nesterovs(0.006, 0.9), l2=1e-4.  One fused XLA training step per
+batch; >97% test accuracy at full size."""
+from _common import example_args, setup_platform
+
+
+def main():
+    args = example_args(__doc__)
+    setup_platform(args.smoke)
+
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+    from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                        OutputLayer)
+    from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+    from deeplearning4j_tpu.optimize.updaters import Nesterovs
+
+    n_train = 8000 if args.smoke else 60000
+    n_epochs = 2 if args.smoke else 5
+    train = MnistDataSetIterator(128, train=True, seed=123,
+                                 n_examples=n_train)
+    test = MnistDataSetIterator(512, train=False, seed=123,
+                                n_examples=max(n_train // 6, 500))
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(123)
+            .updater(Nesterovs(learning_rate=0.006, momentum=0.9))
+            .l2(1e-4)
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_in=784, n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    model.set_listeners(ScoreIterationListener(50))
+    model.fit(train, n_epochs=n_epochs)
+    ev = model.evaluate(test)
+    print(ev.stats())
+    bar = 0.9 if args.smoke else 0.97
+    assert ev.accuracy() > bar, ev.accuracy()
+    print(f"OK accuracy={ev.accuracy():.4f}")
+
+
+if __name__ == "__main__":
+    main()

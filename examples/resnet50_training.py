@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""BASELINE config 2 — ResNet-50 training via the zoo
+(``deeplearning4j-zoo`` ComputationGraph analogue).  Full mode trains
+ImageNet-shaped synthetic batches in bf16 on the chip (the bench.py
+primary metric); --smoke runs a shrunken residual net on CPU."""
+from _common import example_args, setup_platform
+
+
+def main():
+    args = example_args(__doc__)
+    setup_platform(args.smoke)
+
+    import numpy as np
+
+    if args.smoke:
+        from deeplearning4j_tpu.zoo.simple_cnn import SimpleCNN
+        model = SimpleCNN(n_classes=10,
+                          input_shape=(32, 32, 3)).init_graph()
+        batch, hw, ncls, steps = 8, 32, 10, 3
+    else:
+        from deeplearning4j_tpu.zoo.resnet import ResNet50
+        model = ResNet50(n_classes=1000,
+                         input_shape=(224, 224, 3)).init_graph()
+        batch, hw, ncls, steps = 256, 224, 1000, 30
+
+    import time
+    rng = np.random.default_rng(0)
+    losses = []
+    if args.smoke:
+        from deeplearning4j_tpu.data.dataset import DataSet
+        x = rng.normal(size=(batch, hw, hw, 3)).astype(np.float32)
+        y = np.eye(ncls, dtype=np.float32)[rng.integers(0, ncls, batch)]
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            losses.append(float(model.fit(DataSet(x, y))))
+        dt = time.perf_counter() - t0
+    else:
+        import jax.numpy as jnp
+        x = jnp.asarray(rng.normal(size=(batch, hw, hw, 3)), jnp.bfloat16)
+        y = jnp.asarray(np.eye(ncls, dtype=np.float32)[
+            rng.integers(0, ncls, batch)])
+        step = model.compiled_train_step()
+        state = step.init()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = step(state, x, y)
+            losses.append(float(loss))
+        dt = time.perf_counter() - t0
+    assert np.isfinite(losses).all()
+    print(f"OK {steps} steps, loss {losses[0]:.3f} -> {losses[-1]:.3f}, "
+          f"{batch * steps / dt:.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()

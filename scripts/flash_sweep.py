@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Flash-vs-XLA crossover sweep (VERDICT r3 item 10): measure fwd+bwd
+attention time over d in {64,128}, t in {256,512,1024,2048}, with and
+without bias / causal, on the real chip — plus a block-size sweep at
+the causal flagship shape.  Writes FLASH_SWEEP_r04.json; the routing
+table in kernels/flash_attention.py is derived from this artifact.
+
+Protocol: rotate 4 input buffers, 30 timed iters, end with a scalar
+readback; one throwaway warm-up run per config (first-run timings
+through the axon tunnel are poisoned — see bench.py header).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timed(fn, args_list, iters=30):
+    import jax
+    import jax.numpy as jnp
+    out = fn(*args_list[0])
+    jax.block_until_ready(out)
+    for a in args_list:         # warm every buffer's executable path
+        out = fn(*a)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = fn(*args_list[i % len(args_list)])
+    _ = float(jnp.sum(out[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import deeplearning4j_tpu.kernels  # noqa: F401  (registers module)
+    fa = sys.modules["deeplearning4j_tpu.kernels.flash_attention"]
+
+    assert jax.default_backend() == "tpu", "sweep needs the real chip"
+    rng = np.random.default_rng(0)
+    rows = []
+    BATCH_FOR_T = {256: 64, 512: 32, 1024: 16, 2048: 8}
+    for d in (64, 128):
+        h = 12 if d == 64 else 6
+        for t in (256, 512, 1024, 2048):
+            b = BATCH_FOR_T[t]
+            mk = lambda: jnp.asarray(
+                rng.normal(size=(b, h, t, d)), jnp.bfloat16)
+            bufs = [(mk(), mk(), mk()) for _ in range(4)]
+            bias = jnp.zeros((b, 1, 1, t), jnp.float32)
+            for causal in (False, True):
+                for use_bias in (False, True):
+                    bi = bias if use_bias else None
+
+                    def g(fn):
+                        return jax.jit(jax.grad(
+                            lambda q, k, v: jnp.sum(
+                                fn(q, k, v).astype(jnp.float32)),
+                            argnums=(0, 1, 2)))
+
+                    fl = g(lambda q, k, v: fa.flash_attention(
+                        q, k, v, *fa._auto_blocks(t), bias=bi,
+                        causal=causal))
+                    xl = g(lambda q, k, v: fa.xla_attention(
+                        q, k, v, bias=bi, causal=causal))
+                    try:
+                        t_fl = timed(fl, bufs)
+                    except Exception as e:
+                        t_fl = None
+                    t_xl = timed(xl, bufs)
+                    rows.append({
+                        "d": d, "h": h, "t": t, "b": b,
+                        "causal": causal, "bias": use_bias,
+                        "flash_ms": (None if t_fl is None
+                                     else round(t_fl, 3)),
+                        "xla_ms": round(t_xl, 3),
+                        "flash_speedup": (None if t_fl is None else
+                                          round(t_xl / t_fl, 3))})
+                    print(json.dumps(rows[-1]), flush=True)
+
+    # block sweep at the causal flagship shape (t=2048, d=64)
+    b, h, t, d = 8, 12, 2048, 64
+    mk = lambda: jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.bfloat16)
+    bufs = [(mk(), mk(), mk()) for _ in range(4)]
+    blocks = []
+    for bq in (256, 512, 1024):
+        for bk in (256, 512, 1024, 2048):
+            if t % bq or t % bk:
+                continue
+            try:
+                f = jax.jit(jax.grad(
+                    lambda q, k, v, _bq=bq, _bk=bk: jnp.sum(
+                        fa.flash_attention(q, k, v, _bq, _bk,
+                                           causal=True).astype(
+                                               jnp.float32)),
+                    argnums=(0, 1, 2)))
+                ms = timed(f, bufs)
+                blocks.append({"blk_q": bq, "blk_k": bk,
+                               "ms": round(ms, 3)})
+                print(json.dumps(blocks[-1]), flush=True)
+            except Exception as e:
+                blocks.append({"blk_q": bq, "blk_k": bk,
+                               "error": str(e)[:120]})
+
+    out = {"rows": rows, "causal_t2048_block_sweep": blocks,
+           "protocol": "fwd+bwd grad-of-sum, 4 rotating buffers, "
+                       "30 iters, scalar readback, warm-up discarded"}
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "FLASH_SWEEP_r04.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

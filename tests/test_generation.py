@@ -1,0 +1,92 @@
+"""KV-cache incremental decoding (VERDICT r3 item 2): the transformer
+``rnnTimeStep`` analogue.  Greedy decode through the cached one-step
+path must EXACTLY match greedy decode by full-prefix recompute."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.generation import TransformerGenerator
+from deeplearning4j_tpu.zoo.gpt import Gpt
+
+
+def _tiny_gpt(**kw):
+    cfg = dict(vocab_size=50, max_len=32, d_model=32, n_layers=2,
+               n_heads=4, d_ff=64, seq_len=8, compute_dtype=None,
+               seed=3)
+    cfg.update(kw)
+    return Gpt(**cfg).init_graph()
+
+
+def test_cached_greedy_matches_full_recompute():
+    net = _tiny_gpt()
+    gen = TransformerGenerator(net)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 50, (2, 4)).astype(np.int32)
+    t0, n_new = prompt.shape[1], 6
+
+    got = gen.generate(prompt, n_new=n_new)
+    assert got.shape == (2, t0 + n_new)
+    np.testing.assert_array_equal(got[:, :t0], prompt)
+
+    # reference: recompute the FULL prefix every step (no cache)
+    ids = prompt.copy()
+    for _ in range(n_new):
+        probs = np.asarray(net.output(ids))        # [b, t, v]
+        nxt = probs[:, -1].argmax(-1).astype(np.int32)
+        ids = np.concatenate([ids, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, ids)
+
+
+def test_cached_logits_match_full_forward():
+    """Numerical check under the argmax: per-step cached logits equal
+    the full forward's last-position distribution."""
+    net = _tiny_gpt()
+    gen = TransformerGenerator(net)
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, 50, (1, 5)).astype(np.int32)
+    import jax.numpy as jnp
+    emb_p, blk_ps, head_p = gen._params()
+    caches = [(jnp.zeros((1, 4, 8, 8)), jnp.zeros((1, 4, 8, 8)))
+              for _ in gen.blocks]
+    logits = None
+    for pos in range(prompt.shape[1]):
+        logits, caches = gen._step(emb_p, blk_ps, head_p, caches,
+                                   jnp.asarray(prompt[:, pos]), pos)
+    import jax
+    full_probs = np.asarray(net.output(prompt))[:, -1]
+    step_probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    np.testing.assert_allclose(step_probs, full_probs, atol=1e-5)
+
+
+def test_sampling_temperature_and_shapes():
+    net = _tiny_gpt()
+    gen = TransformerGenerator(net)
+    prompt = np.asarray([[1, 2, 3]], np.int32)
+    a = gen.generate(prompt, n_new=5, temperature=1.0, seed=0)
+    b = gen.generate(prompt, n_new=5, temperature=1.0, seed=1)
+    assert a.shape == b.shape == (1, 8)
+    assert (a >= 0).all() and (a < 50).all()
+
+
+def test_generator_rejects_non_causal():
+    from deeplearning4j_tpu.zoo.bert import Bert
+    net = Bert(vocab_size=50, max_len=16, d_model=32, n_layers=1,
+               n_heads=4, d_ff=64, seq_len=8, compute_dtype=None,
+               seed=0).init_graph()
+    with pytest.raises(ValueError):
+        TransformerGenerator(net)
+
+
+def test_gpt_trains_sparse_labels():
+    """The decoder trains with SPARSE [b, t] integer labels (no
+    one-hot): loss finite and decreasing on a copy task."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    net = _tiny_gpt(seq_len=8)
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, 50, (16, 8)).astype(np.int32)
+    labels = np.roll(x, -1, axis=1).astype(np.int32)  # next-token
+    ds = DataSet(x, labels)
+    first = net.fit(ds)
+    for _ in range(30):
+        last = net.fit(ds)
+    assert np.isfinite(last)
+    assert last < first, (first, last)
