@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""ParallelInference dynamic-batching benchmark (VERDICT r3 item 8):
+p50/p99 request latency + sustained throughput vs offered concurrency
+on the real chip, written to SERVING_r04.json.
+
+Model: zoo SimpleCNN at 48x48x3 (a realistic serving-sized CNN).  Each
+client thread issues single-example blocking ``output(x)`` requests in
+a closed loop; the server coalesces concurrent requests into one
+bucketed forward (the DL4J BATCHED inference mode).  Latency is
+per-request wall time; a 2 s warmup per concurrency level is discarded.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run_level(pi, n_clients: int, seconds: float = 6.0,
+              warmup: float = 2.0):
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=(1, 48, 48, 3)).astype(np.float32)
+          for _ in range(8)]
+    stop = time.perf_counter() + warmup + seconds
+    t_measure = time.perf_counter() + warmup
+    lat, count = [], [0]
+    lock = threading.Lock()
+
+    def client(cid):
+        i = 0
+        while True:
+            now = time.perf_counter()
+            if now >= stop:
+                return
+            t0 = time.perf_counter()
+            pi.output(xs[(cid + i) % len(xs)])
+            t1 = time.perf_counter()
+            i += 1
+            if t0 >= t_measure and t1 < stop:
+                # count only requests fully inside the window — else
+                # up to n_clients stragglers overstate req/s
+                with lock:
+                    lat.append(t1 - t0)
+                    count[0] += 1
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lat = np.asarray(sorted(lat))
+    return {
+        "concurrency": n_clients,
+        "requests": int(count[0]),
+        "throughput_req_s": round(count[0] / seconds, 1),
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+        "p90_ms": round(float(np.percentile(lat, 90)) * 1e3, 2),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+    }
+
+
+def main():
+    import jax
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.zoo.simple_cnn import SimpleCNN
+
+    backend = jax.default_backend()
+    model = SimpleCNN(n_classes=10, input_shape=(48, 48, 3)).init_graph()
+    rows = []
+    with ParallelInference(model, batch_limit=64, queue_limit=256,
+                           timeout_ms=2.0) as pi:
+        pi.output(np.zeros((1, 48, 48, 3), np.float32))  # compile
+        for n in (1, 4, 16, 64):
+            rows.append(run_level(pi, n))
+            print(json.dumps(rows[-1]), flush=True)
+    out = {"backend": backend, "model": "SimpleCNN 48x48x3",
+           "batch_limit": 64, "mode": "BATCHED (dynamic coalescing, "
+           "power-of-two padding buckets)", "levels": rows}
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SERVING_r04.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -178,3 +178,109 @@ def test_tf_control_flow_roundtrip(tf_loop_graph, tmp_path):
           if v.var_type == "PLACEHOLDER"][0]
     ours = float(list(sd2.output({ph: np.float32(2.0)}).values())[0])
     assert abs(ours - float(f(tf.constant(2.0)))) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# Round-4 (VERDICT r3 item 5): trainable bounded loops via lax.scan
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tf_trainable_loop_graph():
+    """A frozen TF graph whose LOSS PATH contains a bounded while loop
+    applying a trainable weight each iteration: v = v @ W (3 times)."""
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    w0 = np.random.default_rng(0).normal(
+        scale=0.5, size=(4, 4)).astype(np.float32)
+    w = tf.Variable(w0)
+
+    @tf.function(input_signature=[tf.TensorSpec((None, 4), tf.float32)])
+    def f(x):
+        i = tf.constant(0)
+
+        def c(i, v):
+            return i < 3
+
+        def b(i, v):
+            return i + 1, tf.linalg.matmul(v, w)
+
+        _, v = tf.while_loop(c, b, [i, x])
+        return v
+
+    frozen = convert_variables_to_constants_v2(
+        f.get_concrete_function(), lower_control_flow=False)
+    gd = frozen.graph.as_graph_def()
+    # a captured tf.Variable makes TF emit stateful While (still
+    # functional after freezing); the importer maps both spellings
+    assert {"While", "StatelessWhile"} & {n.op for n in gd.node}
+    return gd, f, w0
+
+
+def test_imported_bounded_loop_scan_converts(tf_trainable_loop_graph):
+    """Forward parity: the scan-converted loop matches TF."""
+    import tensorflow as tf
+    from deeplearning4j_tpu.autodiff.tf_import import import_graph_def
+    gd, f, _ = tf_trainable_loop_graph
+    sd = import_graph_def(gd)
+    node = next(n for n in sd.ops if n.op_name == "while_loop")
+    assert sd._while_static_pattern(node) is not None
+    ph = [v.name for v in sd.vars.values()
+          if v.var_type == "PLACEHOLDER"][0]
+    x = np.random.default_rng(1).normal(size=(2, 4)).astype(np.float32)
+    ours = np.asarray(list(sd.output({ph: x}).values())[0])
+    theirs = f(tf.constant(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_imported_bounded_loop_finetunes(tf_trainable_loop_graph):
+    """Gradients flow THROUGH the imported loop: fine-tune decreases
+    the loss and moves the weight used inside the body."""
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.autodiff.tf_import import import_graph_def
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    gd, _, _ = tf_trainable_loop_graph
+    sd = import_graph_def(gd)
+    ph = [v.name for v in sd.vars.values()
+          if v.var_type == "PLACEHOLDER"][0]
+    out_name = [o for n in sd.ops for o in n.outputs][-1]
+    tgt = sd.placeholder("target", (None, 4), "float32")
+    diff = sd.op("sub", sd.vars[out_name], tgt)
+    sd.set_loss_variables(sd.reduce_mean(sd.op("square", diff),
+                                         name="loss"))
+    sd.set_training_config(TrainingConfig(
+        updater=Sgd(learning_rate=0.05),
+        data_set_feature_mapping=[ph],
+        data_set_label_mapping=["target"]))
+    w_name = next(k for k, v in sd.vars.items()
+                  if v.var_type == "VARIABLE"
+                  and np.asarray(sd.values[k]).shape == (4, 4))
+    before = sd.values[w_name].copy()
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    # achievable target: y = x @ M for a fixed M (so the loop weight
+    # must move to W with W^3 ~ M)
+    m = rng.normal(scale=0.5, size=(4, 4)).astype(np.float32)
+    y = x @ m
+    ds = MultiDataSet([x], [y])
+    losses = sd.fit([ds] * 60, n_epochs=1)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.5 * losses[0], losses
+    assert not np.allclose(sd.values[w_name], before)  # grads reached W
+
+
+def test_unbounded_loop_raises_clear_fit_error():
+    """A loop whose trip count is NOT static raises a clear ValueError
+    at fit time (not a jax differentiation error mid-trace)."""
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    sd, outs = _sum_loop()    # counter starts from a PLACEHOLDER
+    sd.set_loss_variables(sd.reduce_mean(outs[1], name="loss"))
+    sd.set_training_config(TrainingConfig(
+        updater=Sgd(learning_rate=0.1),
+        data_set_feature_mapping=["start"],
+        data_set_label_mapping=[]))
+    with pytest.raises(ValueError, match="scan-convertible"):
+        sd.fit([MultiDataSet([np.int32(0)], [])], n_epochs=1)

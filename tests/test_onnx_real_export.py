@@ -1,0 +1,142 @@
+"""ONNX import of REAL exported models (VERDICT r3 item 3): files
+produced by ``torch.onnx.export`` itself — not hand-built graphs — must
+import through the in-repo wire codec, match the torch forward
+elementwise, and take a fine-tune step.
+
+No ``onnx``/``onnxscript``/``torchvision`` packages exist in this
+image, so (a) export uses the TorchScript exporter with its
+onnxscript-function post-pass no-opped (our graphs contain none), and
+(b) the CNN is a faithful in-file ResNet-18 (conv7x7/2 + BN + maxpool +
+4x2 BasicBlocks + residual downsamples + GAP + fc), exercising Conv /
+BatchNormalization / MaxPool / GlobalAveragePool / Flatten / Gemm /
+Add from a real exporter's opset-17 emission."""
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from deeplearning4j_tpu.autodiff.onnx_import import import_onnx
+
+CACHE = os.environ.get("DL4J_TPU_FIXTURE_CACHE",
+                       "/tmp/deeplearning4j_tpu_fixtures")
+
+
+def _export(model, args, path, **kw):
+    import torch.onnx._internal.torchscript_exporter.onnx_proto_utils \
+        as opu
+    orig = opu._add_onnxscript_fn
+    opu._add_onnxscript_fn = lambda b, c: b   # no onnxscript functions
+    try:
+        torch.onnx.export(model, args, path, opset_version=17,
+                          dynamo=False, **kw)
+    finally:
+        opu._add_onnxscript_fn = orig
+
+
+class _BasicBlock(torch.nn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = torch.nn.BatchNorm2d(cout)
+        self.conv2 = torch.nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = torch.nn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = torch.nn.Sequential(
+                torch.nn.Conv2d(cin, cout, 1, stride, bias=False),
+                torch.nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.down is None else self.down(x)
+        y = torch.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return torch.relu(y + idn)
+
+
+class _ResNet18(torch.nn.Module):
+    def __init__(self, n_classes=10):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = torch.nn.BatchNorm2d(64)
+        self.pool = torch.nn.MaxPool2d(3, 2, 1)
+        layers, cin = [], 64
+        for cout, stride in ((64, 1), (128, 2), (256, 2), (512, 2)):
+            layers += [_BasicBlock(cin, cout, stride),
+                       _BasicBlock(cout, cout)]
+            cin = cout
+        self.blocks = torch.nn.Sequential(*layers)
+        self.gap = torch.nn.AdaptiveAvgPool2d(1)
+        self.fc = torch.nn.Linear(512, n_classes)
+
+    def forward(self, x):
+        y = self.pool(torch.relu(self.bn1(self.conv1(x))))
+        y = self.blocks(y)
+        return self.fc(torch.flatten(self.gap(y), 1))
+
+
+def test_torch_exported_mlp_roundtrip(tmp_path):
+    torch.manual_seed(0)
+    m = torch.nn.Sequential(
+        torch.nn.Linear(6, 16), torch.nn.ReLU(),
+        torch.nn.Linear(16, 8), torch.nn.Tanh(),
+        torch.nn.Linear(8, 3))
+    x = np.random.default_rng(0).normal(size=(5, 6)).astype(np.float32)
+    with torch.no_grad():
+        expected = m(torch.tensor(x)).numpy()
+    p = str(tmp_path / "mlp.onnx")
+    _export(m, (torch.tensor(x),), p, input_names=["x"],
+            output_names=["out"], dynamic_axes={"x": {0: "b"}})
+    sd = import_onnx(p)
+    got = np.asarray(sd.output({"x": x}, ["out"])["out"])
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def resnet18_onnx():
+    os.makedirs(CACHE, exist_ok=True)
+    p = os.path.join(CACHE, "resnet18_torch_export.onnx")
+    g = os.path.join(CACHE, "resnet18_torch_golden.npz")
+    if not (os.path.exists(p) and os.path.exists(g)):
+        torch.manual_seed(0)
+        m = _ResNet18().eval()
+        x = np.random.default_rng(1).normal(
+            size=(2, 3, 64, 64)).astype(np.float32)
+        with torch.no_grad():
+            expected = m(torch.tensor(x)).numpy()
+        _export(m, (torch.tensor(x),), p, input_names=["x"],
+                output_names=["out"])
+        np.savez(g, x=x, expected=expected)
+    return p, np.load(g)
+
+
+def test_torch_exported_resnet18_parity(resnet18_onnx):
+    p, g = resnet18_onnx
+    sd = import_onnx(p)
+    got = np.asarray(sd.output({"x": g["x"]}, ["out"])["out"])
+    np.testing.assert_allclose(got, g["expected"], atol=5e-4)
+
+
+def test_torch_exported_resnet18_finetune_step(resnet18_onnx):
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    p, g = resnet18_onnx
+    sd = import_onnx(p)
+    labels = sd.placeholder("labels", (None,), "int32")
+    per_ex = sd.op("sparse_softmax_cross_entropy_with_logits", labels,
+                   sd.vars["out"])
+    sd.set_loss_variables(sd.reduce_mean(per_ex, name="loss"))
+    sd.set_training_config(TrainingConfig(
+        updater=Sgd(learning_rate=1e-3),
+        data_set_feature_mapping=["x"],
+        data_set_label_mapping=["labels"]))
+    probe = next(k for k, v in sd.vars.items()
+                 if v.var_type == "VARIABLE"
+                 and np.asarray(sd.values[k]).ndim == 4)
+    before = sd.values[probe].copy()
+    ds = MultiDataSet([g["x"]], [np.asarray([0, 1], np.int32)])
+    losses = sd.fit([ds], n_epochs=2)
+    assert np.isfinite(losses).all(), losses
+    assert not np.allclose(sd.values[probe], before)   # convs trained
