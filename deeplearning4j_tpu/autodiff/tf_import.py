@@ -452,6 +452,25 @@ class _Importer:
                     "UnsortedSegmentMax": "unsorted_segment_max"}[op]
             n_seg = int(np.asarray(self._const_of(ins[2])).reshape(()))
             return self._emit(node, name, ins[:2], num_segments=n_seg)
+        if op == "LSTMBlockCell":
+            return self._emit(
+                node, "lstm_block_cell", ins, n_out=7,
+                forget_bias=_tf_attr(node, "forget_bias", 1.0),
+                cell_clip=_tf_attr(node, "cell_clip", 3.0),
+                use_peephole=_tf_attr(node, "use_peephole", False),
+                gate_order="icfo")
+        if op in ("BlockLSTM", "BlockLSTMV2"):
+            v2 = op == "BlockLSTMV2"
+            return self._emit(
+                node, "block_lstm", ins, n_out=7,
+                forget_bias=(0.0 if v2
+                             else _tf_attr(node, "forget_bias", 1.0)),
+                cell_clip=_tf_attr(node, "cell_clip",
+                                   0.0 if v2 else 3.0),
+                use_peephole=_tf_attr(node, "use_peephole", False),
+                gate_order="ifco" if v2 else "icfo")
+        if op == "GRUBlockCell":
+            return self._emit(node, "gru_block_cell", ins, n_out=4)
         if op in ("StatelessWhile", "While"):
             cond_sd = self._import_function(node.attr["cond"].func.name)
             body_sd = self._import_function(node.attr["body"].func.name)

@@ -14,6 +14,13 @@ math on the accelerator.  Both learners share the
 ``_AsyncActorLearner`` scaffolding (rollout template, truncation
 bootstrapping, locked updates, thread fan-out); they differ only in
 action selection, the bootstrap value, and the gradient function.
+
+THROUGHPUT CAVEAT (do not benchmark this): Python thread actors are
+GIL-bound by construction — this module exists for SEMANTIC parity
+with rl4j's async learners (gridworld-scale convergence), not speed.
+The TPU path is the jitted LEARNER (batched rollout gradients on
+device); scale actors via vectorized environments feeding that
+learner, not via more threads here.
 """
 from __future__ import annotations
 
