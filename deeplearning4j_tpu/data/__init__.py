@@ -8,6 +8,7 @@ stays numpy; device transfer happens once per batch at the jit boundary
 (sharded device_put when a mesh is active).
 """
 
+from deeplearning4j_tpu.data.bert_iterator import BertIterator
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterator import (
     AsyncDataSetIterator,
@@ -26,7 +27,7 @@ from deeplearning4j_tpu.data.normalization import (
 )
 
 __all__ = [
-    "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
+    "BertIterator", "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
     "ExistingDataSetIterator", "AsyncDataSetIterator",
     "IrisDataSetIterator", "Cifar10DataSetIterator",
     "NormalizerStandardize", "NormalizerMinMaxScaler",

@@ -16,6 +16,8 @@ from deeplearning4j_tpu.nlp.tokenizer import (DefaultTokenizerFactory,
 from deeplearning4j_tpu.nlp.word2vec import ParagraphVectors, Word2Vec
 from deeplearning4j_tpu.nlp.fasttext import FastText
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+from deeplearning4j_tpu.nlp.wordpiece import BertWordPieceTokenizerFactory
 
-__all__ = ["Word2Vec", "ParagraphVectors", "FastText", "DefaultTokenizerFactory",
+__all__ = ["Word2Vec", "ParagraphVectors", "FastText",
+           "BertWordPieceTokenizerFactory", "DefaultTokenizerFactory",
            "RegexTokenizerFactory", "WordVectorSerializer"]

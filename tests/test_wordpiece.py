@@ -1,0 +1,152 @@
+"""WordPiece tokenizer + BertIterator (the reference's
+``BertWordPieceTokenizerFactory`` / ``BertIterator`` pair).  Goldens:
+the installed ``transformers.BertTokenizer`` over a locally-written
+vocab file — algorithmic parity, no egress."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.bert_iterator import BertIterator
+from deeplearning4j_tpu.nlp.wordpiece import BertWordPieceTokenizerFactory
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "the", "quick", "brown", "fox", "jump", "##s", "##ed", "##ing",
+         "over", "lazy", "dog", "pack", "box", "with", "five", "dozen",
+         "liquor", "jug", "un", "##aff", "##able", ",", ".", "!", "?",
+         "'", "a", "b", "c", "d", "e"]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("wp") / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n")
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def hf(vocab_file):
+    transformers = pytest.importorskip("transformers")
+    return transformers.BertTokenizer(vocab_file=vocab_file,
+                                      do_lower_case=True)
+
+
+SENTENCES = [
+    "The quick brown fox jumps over the lazy dog.",
+    "Pack my box with five dozen liquor jugs!",
+    "unaffable jumping, quick?",
+    "Entirely-unknown words appear",
+    "the the the",
+]
+
+
+def test_tokenize_matches_hf(vocab_file, hf):
+    tok = BertWordPieceTokenizerFactory(vocab_file)
+    for s in SENTENCES:
+        assert tok.tokenize(s) == hf.tokenize(s), s
+
+
+def test_encode_matches_hf(vocab_file, hf):
+    tok = BertWordPieceTokenizerFactory(vocab_file)
+    for s in SENTENCES:
+        enc = hf(s, padding="max_length", truncation=True, max_length=16)
+        ids, mask, tt = tok.encode(s, max_len=16)
+        assert ids == enc["input_ids"], s
+        assert mask == enc["attention_mask"], s
+        assert tt == enc["token_type_ids"], s
+
+
+def test_encode_pair_matches_hf(vocab_file, hf):
+    tok = BertWordPieceTokenizerFactory(vocab_file)
+    a, b = "the quick fox", "a lazy dog!"
+    enc = hf(a, b, padding="max_length", truncation=False, max_length=20)
+    ids, mask, tt = tok.encode(a, pair=b, max_len=20)
+    assert ids == enc["input_ids"]
+    assert tt == enc["token_type_ids"]
+
+
+def test_decode_roundtrip(vocab_file):
+    tok = BertWordPieceTokenizerFactory(vocab_file)
+    ids, _, _ = tok.encode("the quick brown fox jumps")
+    assert tok.decode(ids) == "the quick brown fox jumps"
+
+
+def test_bert_iterator_classification_feeds_imported_graph(vocab_file):
+    """End-to-end BASELINE config 4 pipeline: sentences -> BertIterator
+    -> the imported tiny frozen BERT fine-tunes."""
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.autodiff.tf_import import import_frozen_pb
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    tok = BertWordPieceTokenizerFactory(vocab_file)
+    data = [("the quick brown fox", 1), ("pack my box", 0),
+            ("five dozen liquor jugs", 0), ("lazy dog jumps", 1)] * 2
+    it = BertIterator(tok, data, batch_size=4, max_len=16)
+    pb = os.path.join(os.path.dirname(__file__), "fixtures",
+                      "bert_tiny_frozen.pb")
+    sd = import_frozen_pb(pb)
+    pooled = sd.vars["Identity_1"]
+    w = sd.var("cls_W", np.random.default_rng(0).normal(
+        scale=0.05, size=(64, 2)).astype(np.float32))
+    b = sd.var("cls_b", np.zeros(2, np.float32))
+    logits = sd.op("add", sd.matmul(pooled, w), b, name="logits")
+    labels = sd.placeholder("labels", (None,), "int32")
+    per_ex = sd.op("sparse_softmax_cross_entropy_with_logits", labels,
+                   logits)
+    sd.set_loss_variables(sd.reduce_mean(per_ex, name="loss"))
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(learning_rate=1e-3),
+        data_set_feature_mapping=["i", "m", "t"],
+        data_set_label_mapping=["labels"]))
+    losses = []
+    for _ in range(6):
+        losses.extend(sd.fit(it, n_epochs=1))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_bert_iterator_mlm_masking(vocab_file):
+    tok = BertWordPieceTokenizerFactory(vocab_file)
+    sents = ["the quick brown fox jumps over the lazy dog"] * 8
+    it = BertIterator(tok, sents, batch_size=8, max_len=16,
+                      task="unsupervised", mask_prob=0.5, seed=1)
+    ds = next(iter(it))
+    ids, mask, tt = [np.asarray(a) for a in ds.features]
+    tgt, sel = [np.asarray(a) for a in ds.labels]
+    assert ids.shape == (8, 16)
+    assert sel.sum() > 0
+    cls, sep, pad = (tok.vocab["[CLS]"], tok.vocab["[SEP]"],
+                     tok.vocab["[PAD]"])
+    # selection never hits special or padded positions
+    assert not np.any(sel & np.isin(tgt, [cls, sep, pad]))
+    assert not np.any(sel & (mask == 0))
+    # unselected positions are untouched; most selected become [MASK]
+    assert np.array_equal(ids[sel == 0], tgt[sel == 0])
+    frac_masked = (ids[sel == 1] == tok.vocab["[MASK]"]).mean()
+    assert 0.6 < frac_masked <= 1.0
+
+
+def test_encode_pair_truncation_matches_hf(vocab_file, hf):
+    """Review regression: longest_first pair truncation must keep the
+    segment structure (both [SEP]s, correct token_type_ids)."""
+    tok = BertWordPieceTokenizerFactory(vocab_file)
+    a = "the quick brown fox jumps over the lazy dog"
+    b = "pack box with five dozen"
+    for ml in (12, 13, 16):
+        enc = hf(a, b, padding="max_length", truncation="longest_first",
+                 max_length=ml)
+        ids, mask, tt = tok.encode(a, pair=b, max_len=ml)
+        assert ids == enc["input_ids"], ml
+        assert tt == enc["token_type_ids"], ml
+        assert mask == enc["attention_mask"], ml
+
+
+def test_mlm_always_selects_at_least_one(vocab_file):
+    """Review regression: every example with candidates gets >=1
+    selected position even at tiny mask_prob."""
+    tok = BertWordPieceTokenizerFactory(vocab_file)
+    sents = ["the fox"] * 16
+    it = BertIterator(tok, sents, batch_size=16, max_len=8,
+                      task="unsupervised", mask_prob=0.01, seed=0)
+    ds = next(iter(it))
+    sel = np.asarray(ds.labels[1])
+    assert (sel.sum(axis=1) >= 1).all()
