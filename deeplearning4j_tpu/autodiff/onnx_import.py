@@ -58,6 +58,10 @@ def _attrs(node: dict) -> Dict[str, object]:
             out[a["name"]] = list(a.get("ints", []))
         elif t == O.ATTR_FLOATS:
             out[a["name"]] = list(a.get("floats", []))
+        elif t == O.ATTR_STRINGS:
+            out[a["name"]] = [
+                (v.decode("utf-8") if isinstance(v, bytes) else str(v))
+                for v in a.get("strings", [])]
     return out
 
 
@@ -183,7 +187,11 @@ class _OnnxImporter:
                 self._emit_named("add", [cur, cname], out)
             return
         if op == "Reshape":
-            shape = self._const_of(ins[1])
+            try:
+                shape = self._const_of(ins[1])
+            except ValueError:
+                # graph-computed target: folds to host at trace time
+                return self._emit(node, "reshape_dynamic", ins[:2])
             return self._emit(node, "reshape_with_zero", ins[:1],
                               shape=[int(s) for s in shape])
         if op == "Transpose":
@@ -208,9 +216,49 @@ class _OnnxImporter:
         if op == "Shape":
             return self._emit(node, "shape", ins)
         if op == "Expand":
-            shape = self._const_of(ins[1])
+            try:
+                shape = self._const_of(ins[1])
+            except ValueError:
+                return self._emit(node, "broadcast_to_dynamic",
+                                  ins[:2])
             return self._emit(node, "broadcast_to", ins[:1],
                               shape=[int(s) for s in shape])
+        if op in ("LSTM", "GRU"):
+            defaults = (["Sigmoid", "Tanh", "Tanh"] if op == "LSTM"
+                        else ["Sigmoid", "Tanh"])
+            acts = a.get("activations")
+            n_dir = 2 if a.get("direction") == "bidirectional" else 1
+            if acts and acts != defaults * n_dir:
+                raise NotImplementedError(
+                    f"ONNX {op} with non-default activations {acts}")
+            if float(a.get("clip", 0.0) or 0.0) != 0.0:
+                raise NotImplementedError(f"ONNX {op} clip attribute")
+            if op == "LSTM" and int(a.get("input_forget", 0)):
+                raise NotImplementedError("ONNX LSTM input_forget")
+            present = [i for i, v in enumerate(ins) if v is not None]
+            kw = {"present": present,
+                  "hidden_size": int(a["hidden_size"]),
+                  "direction": a.get("direction", "forward")}
+            if op == "GRU":
+                kw["linear_before_reset"] = int(
+                    a.get("linear_before_reset", 0))
+            # Position-preserving outputs: exporters prune unused
+            # trailing outputs and blank unused middles; the op always
+            # returns the full tuple, so synthesize names for holes
+            # (the executor's multi-output zip binds by position).
+            n_out = 3 if op == "LSTM" else 2
+            decl = list(node.get("output", []))
+            while len(decl) < n_out:
+                decl.append("")
+            base = next((o for o in decl if o), "rnn")
+            outs = [o if o else f"{base}/unused_{i}"
+                    for i, o in enumerate(decl[:n_out])]
+            self.sd.ops.append(OpNode(
+                f"onnx_{op.lower()}",
+                [ins[i].name for i in present], outs, kw))
+            for o in outs:
+                self.tensors[o] = self.sd._register(o, "ARRAY")
+            return [self.tensors[o] for o in outs]
         if op == "Softmax":
             # Opset>=13: elementwise softmax over `axis` (default -1).
             # Pre-13: default axis=1 with flatten-to-2D semantics

@@ -948,11 +948,17 @@ def _unsqueeze_onnx(x, axis):
     # ONNX Unsqueeze axes are relative to the OUTPUT rank; normalize
     # negatives against ndim+len(axes) before inserting in ascending
     # order (axes=[-1,-3] on (2,3) -> (2,1,3,1), not (1,2,3,1)).
+    # Host-preserving (_xp): shape-metaprogramming chains (Shape ->
+    # Gather -> Unsqueeze -> Concat, e.g. torch LSTM h0 Expands) must
+    # stay constant-foldable.
+    m = _xp(x)
+    if m is np:
+        x = np.asarray(x)
     axes = [int(v) for v in np.asarray(axis).reshape(-1)]
-    out_rank = x.ndim + len(axes)
+    out_rank = np.ndim(x) + len(axes)
     norm = sorted(a + out_rank if a < 0 else a for a in axes)
     for a in norm:
-        x = jnp.expand_dims(x, a)
+        x = m.expand_dims(x, a)
     return x
 
 
@@ -1169,3 +1175,159 @@ def _gru_block_cell(x, h_prev, w_ru, w_c, b_ru, b_c):
     c = jnp.tanh(xrh @ w_c + b_c)
     h = u * h_prev + (1.0 - u) * c
     return r, u, c, h
+
+
+# ---------------------------------------------------------------------------
+# ONNX recurrent ops (torch.onnx.export emits these for nn.LSTM/GRU).
+# ONNX gate orders: LSTM [i o f c], GRU [z r h].  Optional inputs are
+# slot-encoded via the ``present`` attr (ONNX's empty-string inputs
+# collapse positions otherwise).
+# ---------------------------------------------------------------------------
+def _slotted(args, present):
+    slots = {}
+    for p, a in zip(present, args):
+        slots[int(p)] = a
+    return slots
+
+
+@register_op("onnx_lstm", n_out=3)
+def _onnx_lstm(*args, present=(0, 1, 2), hidden_size=None,
+               direction="forward"):
+    s = _slotted(args, present)
+    x, w, r = s[0], s[1], s[2]
+    if 4 in s and s[4] is not None:
+        raise NotImplementedError("ONNX LSTM sequence_lens")
+    if 7 in s:
+        raise NotImplementedError("ONNX LSTM peepholes")
+    t, bsz, _ = x.shape
+    nd = w.shape[0]
+    h = int(hidden_size or w.shape[1] // 4)
+    b_all = s.get(3)
+    if b_all is None:
+        b_all = jnp.zeros((nd, 8 * h), x.dtype)
+    h0 = s.get(5)
+    c0 = s.get(6)
+    if h0 is None:
+        h0 = jnp.zeros((nd, bsz, h), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((nd, bsz, h), x.dtype)
+
+    def run_dir(d, reverse):
+        wi, ri = w[d], r[d]
+        bias = b_all[d, :4 * h] + b_all[d, 4 * h:]
+        xs = jnp.flip(x, 0) if reverse else x
+
+        def step(carry, xt):
+            hp, cp = carry
+            g = xt @ wi.T + hp @ ri.T + bias
+            i_, o_, f_, c_ = jnp.split(g, 4, -1)      # ONNX iofc
+            i_ = jax.nn.sigmoid(i_)
+            o_ = jax.nn.sigmoid(o_)
+            f_ = jax.nn.sigmoid(f_)
+            c = f_ * cp + i_ * jnp.tanh(c_)
+            hh = o_ * jnp.tanh(c)
+            return (hh, c), hh
+
+        (hT, cT), ys = lax.scan(step, (h0[d], c0[d]), xs)
+        if reverse:
+            ys = jnp.flip(ys, 0)
+        return ys, hT, cT
+
+    dirs = {"forward": [(0, False)], "reverse": [(0, True)],
+            "bidirectional": [(0, False), (1, True)]}[str(direction)]
+    outs = [run_dir(d, rev) for d, rev in dirs]
+    y = jnp.stack([o[0] for o in outs], axis=1)       # [t, nd, b, h]
+    y_h = jnp.stack([o[1] for o in outs], axis=0)
+    y_c = jnp.stack([o[2] for o in outs], axis=0)
+    return y, y_h, y_c
+
+
+@register_op("onnx_gru", n_out=2)
+def _onnx_gru(*args, present=(0, 1, 2), hidden_size=None,
+              direction="forward", linear_before_reset=0):
+    s = _slotted(args, present)
+    x, w, r = s[0], s[1], s[2]
+    if 4 in s and s[4] is not None:
+        raise NotImplementedError("ONNX GRU sequence_lens")
+    t, bsz, _ = x.shape
+    nd = w.shape[0]
+    h = int(hidden_size or w.shape[1] // 3)
+    b_all = s.get(3)
+    if b_all is None:
+        b_all = jnp.zeros((nd, 6 * h), x.dtype)
+    h0 = s.get(5)
+    if h0 is None:
+        h0 = jnp.zeros((nd, bsz, h), x.dtype)
+
+    def run_dir(d, reverse):
+        wi, ri = w[d], r[d]
+        wb, rb = b_all[d, :3 * h], b_all[d, 3 * h:]
+        xs = jnp.flip(x, 0) if reverse else x
+
+        lbr = bool(int(linear_before_reset))
+
+        def step(hp, xt):
+            gx = xt @ wi.T + wb
+            zx, rx, hx = jnp.split(gx, 3, -1)         # ONNX zrh
+            if lbr:
+                gh = hp @ ri.T + rb
+                zh, rh, hh_ = jnp.split(gh, 3, -1)
+            else:   # h-gate recurrence applies AFTER reset: don't
+                    # burn a third of the recurrent matmul on it here
+                zh, rh = jnp.split(hp @ ri[:2 * h].T + rb[:2 * h],
+                                   2, -1)
+            z = jax.nn.sigmoid(zx + zh)
+            rr = jax.nn.sigmoid(rx + rh)
+            if lbr:
+                ht = jnp.tanh(hx + rr * hh_)
+            else:
+                ht = jnp.tanh(hx + (rr * hp) @ ri[2 * h:].T
+                              + rb[2 * h:])
+            hn = (1.0 - z) * ht + z * hp
+            return hn, hn
+
+        hT, ys = lax.scan(step, h0[d], xs)
+        if reverse:
+            ys = jnp.flip(ys, 0)
+        return ys, hT
+
+    dirs = {"forward": [(0, False)], "reverse": [(0, True)],
+            "bidirectional": [(0, False), (1, True)]}[str(direction)]
+    outs = [run_dir(d, rev) for d, rev in dirs]
+    y = jnp.stack([o[0] for o in outs], axis=1)
+    y_h = jnp.stack([o[1] for o in outs], axis=0)
+    return y, y_h
+
+
+@register_op("broadcast_to_dynamic")
+def _broadcast_to_dynamic(x, shape):
+    """ONNX Expand whose target rides the graph (Shape->...->Concat):
+    the shape chain constant-folds to a HOST vector at trace time (see
+    module docstring); anything else is a data-dependent shape XLA
+    cannot compile — fail loudly."""
+    if not is_static_value(shape):
+        raise ValueError(
+            "Expand target shape did not constant-fold at trace time "
+            "(data-dependent shapes are not compilable)")
+    tgt = [int(s) for s in np.asarray(shape).reshape(-1)]
+    # ONNX Expand: BIDIRECTIONAL numpy broadcast — right-align and pad
+    # BOTH sides to the max rank (a target shorter than x's rank is
+    # legal and must not truncate x)
+    xs = list(np.shape(x))
+    rank = max(len(xs), len(tgt))
+    xs = [1] * (rank - len(xs)) + xs
+    tgt = [1] * (rank - len(tgt)) + tgt
+    out = [max(a, b) for a, b in zip(xs, tgt)]
+    return _xp(x).broadcast_to(x, tuple(out))
+
+
+@register_op("reshape_dynamic")
+def _reshape_dynamic(x, shape):
+    """ONNX Reshape with a graph-computed target (host at trace time);
+    supports 0 = copy input dim and a single -1."""
+    if not is_static_value(shape):
+        raise ValueError(
+            "Reshape target did not constant-fold at trace time")
+    tgt = [int(s) for s in np.asarray(shape).reshape(-1)]
+    tgt = [np.shape(x)[i] if s == 0 else s for i, s in enumerate(tgt)]
+    return _xp(x).reshape(x, tuple(tgt))

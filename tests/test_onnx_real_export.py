@@ -140,3 +140,125 @@ def test_torch_exported_resnet18_finetune_step(resnet18_onnx):
     losses = sd.fit([ds], n_epochs=2)
     assert np.isfinite(losses).all(), losses
     assert not np.allclose(sd.values[probe], before)   # convs trained
+
+
+def test_torch_exported_lstm_parity(tmp_path):
+    """torch.nn.LSTM -> ONNX LSTM node -> import -> elementwise parity
+    on all three outputs (y, h, c)."""
+    torch.manual_seed(0)
+    m = torch.nn.LSTM(input_size=4, hidden_size=6, num_layers=1)
+    x = torch.randn(5, 2, 4)
+    with torch.no_grad():
+        y, (h, c) = m(x)
+    p = str(tmp_path / "lstm.onnx")
+    _export(m, (x,), p, input_names=["x"],
+            output_names=["y", "h", "c"])
+    sd = import_onnx(p)
+    got = sd.output({"x": x.numpy()}, ["y", "h", "c"])
+    np.testing.assert_allclose(np.asarray(got["y"]), y.numpy(),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got["h"]), h.numpy(),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got["c"]), c.numpy(),
+                               atol=1e-5)
+
+
+def test_torch_exported_bilstm_parity(tmp_path):
+    torch.manual_seed(1)
+    m = torch.nn.LSTM(input_size=3, hidden_size=4, num_layers=1,
+                      bidirectional=True)
+    x = torch.randn(6, 2, 3)
+    with torch.no_grad():
+        y, _ = m(x)
+    p = str(tmp_path / "bilstm.onnx")
+    _export(m, (x,), p, input_names=["x"],
+            output_names=["y", "h", "c"])
+    sd = import_onnx(p)
+    got = np.asarray(sd.output({"x": x.numpy()}, ["y"])["y"])
+    np.testing.assert_allclose(got, y.numpy(), atol=1e-5)
+
+
+def test_torch_exported_gru_parity(tmp_path):
+    torch.manual_seed(2)
+    m = torch.nn.GRU(input_size=4, hidden_size=5, num_layers=1)
+    x = torch.randn(5, 2, 4)
+    with torch.no_grad():
+        y, h = m(x)
+    p = str(tmp_path / "gru.onnx")
+    _export(m, (x,), p, input_names=["x"], output_names=["y", "h"])
+    sd = import_onnx(p)
+    got = sd.output({"x": x.numpy()}, ["y", "h"])
+    np.testing.assert_allclose(np.asarray(got["y"]), y.numpy(),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got["h"]), h.numpy(),
+                               atol=1e-5)
+
+
+def test_torch_exported_lstm_finetunes(tmp_path):
+    """Gradients flow through the imported ONNX LSTM scan."""
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    torch.manual_seed(3)
+    m = torch.nn.LSTM(input_size=3, hidden_size=4, num_layers=1)
+    x = torch.randn(5, 4, 3)
+    p = str(tmp_path / "lstm_ft.onnx")
+    _export(m, (x,), p, input_names=["x"],
+            output_names=["y", "h", "c"])
+    sd = import_onnx(p)
+    tgt = sd.placeholder("tgt", (None, None, 4), "float32")
+    d = sd.op("sub", sd.vars["y"], tgt)
+    sd.set_loss_variables(sd.reduce_mean(sd.op("square", d),
+                                         name="loss"))
+    sd.set_training_config(TrainingConfig(
+        updater=Sgd(learning_rate=0.1),
+        data_set_feature_mapping=["x"], data_set_label_mapping=["tgt"]))
+    kern = next(k for k, v in sd.vars.items()
+                if v.var_type == "VARIABLE"
+                and np.asarray(sd.values[k]).ndim == 3
+                and np.asarray(sd.values[k]).shape[-1] == 3)
+    before = sd.values[kern].copy()
+    rng = np.random.default_rng(0)
+    ds = MultiDataSet([x.numpy()],
+                      [rng.normal(size=(5, 4, 4)).astype(np.float32)])
+    losses = sd.fit([ds] * 15, n_epochs=1)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    assert not np.allclose(sd.values[kern], before)
+
+
+def test_torch_exported_lstm_pruned_outputs(tmp_path):
+    """Review regression: a module returning ONLY y prunes the ONNX
+    LSTM node to one declared output — position binding must hold."""
+    torch.manual_seed(4)
+
+    class OnlyY(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lstm = torch.nn.LSTM(3, 4)
+
+        def forward(self, x):
+            y, _ = self.lstm(x)
+            return y.sum(dim=2)
+
+    m = OnlyY()
+    x = torch.randn(5, 2, 3)
+    with torch.no_grad():
+        expected = m(x).numpy()
+    p = str(tmp_path / "onlyy.onnx")
+    _export(m, (x,), p, input_names=["x"], output_names=["out"])
+    sd = import_onnx(p)
+    got = np.asarray(sd.output({"x": x.numpy()}, ["out"])["out"])
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_expand_target_shorter_than_input_rank():
+    """Review regression: ONNX Expand's bidirectional broadcast with a
+    target of LOWER rank than x must keep x's rank."""
+    from deeplearning4j_tpu.autodiff.ops import get_op
+    x = np.ones((2, 3), np.float32)
+    out = get_op("broadcast_to_dynamic").fn(x, np.asarray([3]))
+    assert np.shape(out) == (2, 3)
+    out2 = get_op("broadcast_to_dynamic").fn(
+        np.ones((1, 3), np.float32), np.asarray([4, 2, 3]))
+    assert np.shape(out2) == (4, 2, 3)
