@@ -235,9 +235,13 @@ class _OnnxImporter:
                 raise NotImplementedError(f"ONNX {op} clip attribute")
             if op == "LSTM" and int(a.get("input_forget", 0)):
                 raise NotImplementedError("ONNX LSTM input_forget")
+            if int(a.get("layout", 0)):
+                raise NotImplementedError(
+                    f"ONNX {op} layout=1 (batch-major)")
             present = [i for i, v in enumerate(ins) if v is not None]
+            hs = a.get("hidden_size")      # optional; ops derive from W
             kw = {"present": present,
-                  "hidden_size": int(a["hidden_size"]),
+                  "hidden_size": None if hs is None else int(hs),
                   "direction": a.get("direction", "forward")}
             if op == "GRU":
                 kw["linear_before_reset"] = int(

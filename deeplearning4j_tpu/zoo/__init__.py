@@ -16,10 +16,14 @@ from deeplearning4j_tpu.zoo.unet import UNet
 from deeplearning4j_tpu.zoo.inception import InceptionResNetV1
 from deeplearning4j_tpu.zoo.darknet import Darknet19, TinyYOLO, Yolo2OutputLayer
 from deeplearning4j_tpu.zoo.bert import Bert
+from deeplearning4j_tpu.zoo.gpt import Gpt
+from deeplearning4j_tpu.zoo.squeezenet import SqueezeNet
+from deeplearning4j_tpu.zoo.xception import Xception
 from deeplearning4j_tpu.zoo.pretrained import (load_pretrained, register,
                                                save_pretrained)
 
 __all__ = ["ZooModel", "LeNet", "AlexNet", "VGG16", "VGG19", "ResNet50",
            "SimpleCNN", "TextGenerationLSTM", "UNet", "InceptionResNetV1",
-           "Darknet19", "TinyYOLO", "Yolo2OutputLayer", "Bert",
+           "Darknet19", "TinyYOLO", "Yolo2OutputLayer", "Bert", "Gpt",
+           "SqueezeNet", "Xception",
            "save_pretrained", "load_pretrained", "register"]

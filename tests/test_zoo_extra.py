@@ -163,3 +163,43 @@ def test_yolo_checkpoint_restores_without_zoo_import(tmp_path):
                        capture_output=True, timeout=180)
     assert r.returncode == 0, r.stderr.decode()[-2000:]
     assert b"RESTORED ComputationGraph" in r.stdout
+
+
+def test_squeezenet_builds_and_learns():
+    """Fire modules (1x1 squeeze -> concat(1x1, 3x3) expands), class
+    conv + GAP head — `SqueezeNet` zoo parity entry."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.zoo import SqueezeNet
+    rng = np.random.default_rng(0)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    m = SqueezeNet(n_classes=4, input_shape=(64, 64, 3), seed=1,
+                   fire_plan=((8, 16), (8, 16)), pool_after=(0,),
+                   updater=Adam(learning_rate=3e-3)).init_graph()
+    # separable color-blob task
+    labels = rng.integers(0, 4, 16)
+    x = np.zeros((16, 64, 64, 3), np.float32)
+    for i, c in enumerate(labels):
+        x[i, :, :, c % 3] = 0.5 + 0.5 * (c // 3)
+        x[i] += rng.normal(0, 0.05, (64, 64, 3))
+    y = np.eye(4, dtype=np.float32)[labels]
+    first = m.fit(DataSet(x, y))
+    for _ in range(100):
+        last = m.fit(DataSet(x, y))
+    assert np.isfinite(last) and last < 0.5 * first, (first, last)
+    assert np.asarray(m.output(x)).shape == (16, 4)
+
+
+def test_xception_builds_and_trains():
+    """Separable-conv entry/middle/exit flows with residual skips —
+    `Xception` zoo parity entry (shrunken)."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.zoo import Xception
+    rng = np.random.default_rng(1)
+    m = Xception(n_classes=3, input_shape=(64, 64, 3), width=8,
+                 middle_blocks=1, seed=2).init_graph()
+    x = rng.normal(size=(4, 64, 64, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+    losses = [m.fit(DataSet(x, y)) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    assert np.asarray(m.output(x)).shape == (4, 3)
