@@ -4,6 +4,12 @@ Each zoo class mirrors a DL4J ``org.deeplearning4j.zoo.model.*`` builder:
 a named architecture with the reference hyperparameters, constructed on the
 framework's own config system (GraphBuilder / ListBuilder) — so every zoo
 model is also a round-trippable JSON config, exactly like upstream.
+
+Coverage vs the upstream zoo table: all entries except NASNet (its
+cell-search architecture is a large fixed DAG with no users in the
+reference's own examples; the inception/separable machinery it needs —
+MergeVertex, SeparableConvolution2D, ReorgVertex — all exist here, so
+it is an afternoon of transcription, not a capability gap).
 """
 from deeplearning4j_tpu.zoo.base import ZooModel
 from deeplearning4j_tpu.zoo.lenet import LeNet
@@ -14,7 +20,9 @@ from deeplearning4j_tpu.zoo.simple_cnn import SimpleCNN
 from deeplearning4j_tpu.zoo.text_generation_lstm import TextGenerationLSTM
 from deeplearning4j_tpu.zoo.unet import UNet
 from deeplearning4j_tpu.zoo.inception import InceptionResNetV1
-from deeplearning4j_tpu.zoo.darknet import Darknet19, TinyYOLO, Yolo2OutputLayer
+from deeplearning4j_tpu.zoo.darknet import (Darknet19, TinyYOLO, YOLO2,
+                                            Yolo2OutputLayer)
+from deeplearning4j_tpu.zoo.facenet import FaceNetNN4Small2
 from deeplearning4j_tpu.zoo.bert import Bert
 from deeplearning4j_tpu.zoo.gpt import Gpt
 from deeplearning4j_tpu.zoo.squeezenet import SqueezeNet
@@ -24,6 +32,7 @@ from deeplearning4j_tpu.zoo.pretrained import (load_pretrained, register,
 
 __all__ = ["ZooModel", "LeNet", "AlexNet", "VGG16", "VGG19", "ResNet50",
            "SimpleCNN", "TextGenerationLSTM", "UNet", "InceptionResNetV1",
-           "Darknet19", "TinyYOLO", "Yolo2OutputLayer", "Bert", "Gpt",
+           "Darknet19", "TinyYOLO", "YOLO2", "FaceNetNN4Small2",
+           "Yolo2OutputLayer", "Bert", "Gpt",
            "SqueezeNet", "Xception",
            "save_pretrained", "load_pretrained", "register"]

@@ -105,3 +105,51 @@ class TinyYOLO(ZooModel):
         g.add_layer("output", Yolo2OutputLayer(n_classes=self.n_classes),
                     "det")
         return g.set_outputs("output").build()
+
+
+@dataclasses.dataclass
+class YOLO2(ZooModel):
+    """YOLOv2 (``org.deeplearning4j.zoo.model.YOLO2`` [UNVERIFIED]):
+    Darknet19-style backbone plus the PASSTHROUGH route — the
+    higher-resolution mid-backbone feature map space-to-depth-reorged
+    (``SpaceToDepthLayer``, upstream's own choice for this graph) and
+    concatenated with the deep features before the 1x1 detection conv
+    into ``Yolo2OutputLayer``."""
+
+    n_classes: int = 4
+    width: int = 16
+    updater: object = None
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+        from deeplearning4j_tpu.nn.conf.layers_conv import (
+            SpaceToDepthLayer)
+        h, w, c = self.input_shape
+        f = self.width
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(self.updater or Adam(learning_rate=1e-3))
+             .weight_init("relu")
+             .graph().add_inputs("input")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        x = _dn_conv(g, "c1", "input", f)
+        g.add_layer("p1", SubsamplingLayer(kernel_size=(2, 2),
+                                           stride=(2, 2)), x)
+        x = _dn_conv(g, "c2", "p1", 2 * f)
+        g.add_layer("p2", SubsamplingLayer(kernel_size=(2, 2),
+                                           stride=(2, 2)), x)
+        x = _dn_conv(g, "c3", "p2", 4 * f)
+        fine = x                     # passthrough source (higher res)
+        g.add_layer("p3", SubsamplingLayer(kernel_size=(2, 2),
+                                           stride=(2, 2)), x)
+        x = _dn_conv(g, "c4", "p3", 8 * f)
+        x = _dn_conv(g, "c5", x, 8 * f)
+        g.add_layer("reorg", SpaceToDepthLayer(block_size=2),
+                    fine)
+        g.add_vertex("route", MergeVertex(), "reorg", x)
+        x = _dn_conv(g, "c6", "route", 8 * f)
+        g.add_layer("det", ConvolutionLayer(
+            kernel_size=(1, 1), n_out=5 + self.n_classes,
+            convolution_mode="same", activation="identity"), x)
+        g.add_layer("output", Yolo2OutputLayer(n_classes=self.n_classes),
+                    "det")
+        return g.set_outputs("output").build()

@@ -203,3 +203,53 @@ def test_xception_builds_and_trains():
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
     assert np.asarray(m.output(x)).shape == (4, 3)
+
+
+def test_yolo2_passthrough_reorg_trains():
+    """YOLOv2 with the passthrough route: mid-backbone features
+    space-to-depth reorged + concatenated before detection."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.zoo import YOLO2
+    rng = np.random.default_rng(0)
+    m = YOLO2(n_classes=3, width=8, input_shape=(64, 64, 3),
+              seed=4).init_graph()
+    x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    y = np.zeros((2, 8, 8, 8), np.float32)
+    y[0, 2, 3] = [1, .5, .5, .2, .3, 1, 0, 0]
+    y[1, 5, 1] = [1, .4, .6, .1, .2, 0, 0, 1]
+    losses = [float(m.fit(DataSet(x, y))) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_space_to_depth_passthrough_exact():
+    from deeplearning4j_tpu.nn.conf.layers_conv import SpaceToDepthLayer
+    import jax.numpy as jnp
+    x = np.arange(2 * 4 * 4 * 3, dtype=np.float32).reshape(2, 4, 4, 3)
+    out, _ = SpaceToDepthLayer(block_size=2).apply(
+        {}, {}, jnp.asarray(x), training=False)
+    out = np.asarray(out)
+    assert out.shape == (2, 2, 2, 12)
+    # block (0,0) of example 0: rows 0-1 x cols 0-1, channel-major
+    np.testing.assert_array_equal(
+        out[0, 0, 0], x[0, 0:2, 0:2, :].reshape(-1))
+
+
+def test_facenet_center_loss_embedding_trains():
+    """FaceNetNN4Small2: inception branches -> L2-normalized embedding
+    -> center-loss softmax; embeddings come out unit-norm."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.zoo import FaceNetNN4Small2
+    rng = np.random.default_rng(1)
+    m = FaceNetNN4Small2(n_classes=4, width=8, embedding_size=32,
+                         input_shape=(64, 64, 3), seed=5).init_graph()
+    x = rng.normal(size=(8, 64, 64, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+    losses = [float(m.fit(DataSet(x, y))) for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    # the embedding really is L2-normalized per example
+    acts = m.feed_forward([x], training=False)
+    emb = np.asarray(acts["l2"])
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1),
+                               np.ones(len(emb)), atol=1e-5)
