@@ -9,5 +9,6 @@ per-layer views (a flattened view is still offered for parity).
 """
 
 from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.models.transfer_learning import TransferLearning
 
-__all__ = ["MultiLayerNetwork"]
+__all__ = ["MultiLayerNetwork", "TransferLearning"]

@@ -172,6 +172,20 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # Training
     # ------------------------------------------------------------------
+    def _trainable_mask(self):
+        """0/1 mask pytree from conf.frozen_layers (persisted through
+        save/load) or an explicit _trainable_tree override."""
+        explicit = getattr(self, "_trainable_tree", None)
+        if explicit is not None:
+            return explicit
+        frozen = set(getattr(self.conf, "frozen_layers", ()) or ())
+        if not frozen:
+            return None
+        return {f"layer_{i}": jax.tree_util.tree_map(
+                    lambda _: 0.0 if i in frozen else 1.0,
+                    self.params_tree[f"layer_{i}"])
+                for i in range(len(self.layers))}
+
     def _build_solver(self):
         if self._solver is not None:
             return
@@ -192,6 +206,7 @@ class MultiLayerNetwork:
             grad_norm_threshold=self.conf.grad_norm_threshold,
             minimize=self.conf.global_conf.minimize,
             decay_tree=decay_tree if any_decay else None,
+            trainable_tree=self._trainable_mask(),
         )
         if self.opt_state is None:
             self.opt_state = self._solver.init_opt_state(self.params_tree)

@@ -1,0 +1,145 @@
+"""Transfer learning (``org.deeplearning4j.nn.transferlearning
+.TransferLearning`` + ``FrozenLayer`` [UNVERIFIED]): take a trained
+``MultiLayerNetwork``, freeze a feature-extractor prefix, replace /
+remove / append head layers, and fine-tune under a new training
+configuration — the workflow the reference's zoo-pretrained examples
+are built around.
+
+TPU-first mechanics: freezing is a 0/1 mask pytree that zeroes frozen
+grads BEFORE normalization/updater and masks updates after (one fused
+op, no per-layer Java ``FrozenLayer`` wrappers); the frozen-layer list
+persists in the serialized conf so a reloaded fine-tune keeps its
+freeze.  Retained parameters are deep-copied — the jitted step donates
+its buffers, so reference sharing would delete the source model's
+arrays on the first fit.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import List, Optional
+
+import jax
+
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+from deeplearning4j_tpu.optimize.updaters import BaseUpdater
+
+
+class TransferLearning:
+    """Namespace matching upstream: ``TransferLearning.Builder(model)``."""
+
+    class Builder:
+        def __init__(self, model: MultiLayerNetwork):
+            model._check_init()
+            self._src = model
+            self._layers: List = [copy.deepcopy(ly)
+                                  for ly in model.layers]
+            # which source layer each new slot copies params from
+            self._param_src: List[Optional[int]] = list(
+                range(len(self._layers)))
+            self._freeze_upto = -1
+            self._global_overrides = {}
+
+        # -- upstream builder surface ---------------------------------
+        def fine_tune_configuration(self, updater=None, l2=None,
+                                    seed=None):
+            """New training hyperparameters for the fine-tune phase
+            (upstream ``FineTuneConfiguration``)."""
+            if updater is not None:
+                self._global_overrides["updater"] = (
+                    updater.to_dict() if isinstance(updater, BaseUpdater)
+                    else dict(updater))
+            if l2 is not None:
+                self._global_overrides["l2"] = float(l2)
+                # copied layers carry the SOURCE build's resolved l2;
+                # reset so the new global value re-resolves onto them
+                for ly in self._layers:
+                    if hasattr(ly, "l2"):
+                        ly.l2 = None
+            if seed is not None:
+                self._global_overrides["seed"] = int(seed)
+            return self
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers [0..layer_idx] (inclusive) — they forward
+            but never update (upstream ``setFeatureExtractor``)."""
+            self._freeze_upto = int(layer_idx)
+            return self
+
+        def n_out_replace(self, layer_idx: int, n_out: int):
+            """Change layer ``layer_idx``'s output width; that layer
+            AND the next layer re-initialize (their shapes change) —
+            upstream ``nOutReplace`` semantics."""
+            i = int(layer_idx)
+            ly = self._layers[i]
+            if not hasattr(ly, "n_out"):
+                raise ValueError(
+                    f"layer {i} ({type(ly).__name__}) has no n_out")
+            ly.n_out = int(n_out)
+            self._param_src[i] = None
+            if i + 1 < len(self._layers):
+                nxt = self._layers[i + 1]
+                if hasattr(nxt, "n_in"):
+                    nxt.n_in = int(n_out)
+                self._param_src[i + 1] = None
+            return self
+
+        def remove_output_layer_and_processing(self):
+            """Drop the last layer (upstream
+            ``removeOutputLayerAndProcessing``)."""
+            self._layers.pop()
+            self._param_src.pop()
+            return self
+
+        def remove_layers_from_output(self, n: int):
+            for _ in range(int(n)):
+                self.remove_output_layer_and_processing()
+            return self
+
+        def add_layer(self, layer_conf):
+            """Append a fresh (randomly initialized) layer."""
+            self._layers.append(layer_conf)
+            self._param_src.append(None)
+            return self
+
+        # -- build ----------------------------------------------------
+        def build(self) -> MultiLayerNetwork:
+            src = self._src
+            g = dataclasses.replace(src.conf.global_conf,
+                                    **self._global_overrides)
+            b = NeuralNetConfiguration.builder()
+            b._g = g
+            lst = b.list()
+            if src.conf.input_type is not None:
+                lst.set_input_type(src.conf.input_type)
+            if src.conf.backprop_type != "standard":
+                lst.backprop_type(src.conf.backprop_type,
+                                  src.conf.tbptt_fwd_length,
+                                  src.conf.tbptt_bwd_length)
+            for ly in self._layers:
+                lst.layer(ly)
+            model = MultiLayerNetwork(lst.build()).init()
+
+            # COPY retained parameters: the solver's jitted step
+            # DONATES its buffers, so sharing arrays by reference would
+            # delete the source model's params on the first ft.fit()
+            import jax.numpy as jnp
+            for i, src_i in enumerate(self._param_src):
+                if src_i is None:
+                    continue
+                model.params_tree[f"layer_{i}"] = jax.tree_util.tree_map(
+                    jnp.array, src.params_tree[f"layer_{src_i}"])
+                model.state_tree[f"layer_{i}"] = jax.tree_util.tree_map(
+                    jnp.array, src.state_tree[f"layer_{src_i}"])
+
+            if self._freeze_upto >= 0:
+                # persisted in the conf: save/load keeps the freeze
+                model.conf.frozen_layers = list(
+                    range(self._freeze_upto + 1))
+            return model
+
+
+def frozen_layer_indices(model: MultiLayerNetwork) -> List[int]:
+    """Which layers are frozen (from the persisted conf)."""
+    return sorted(getattr(model.conf, "frozen_layers", ()) or ())

@@ -191,6 +191,9 @@ class MultiLayerConfiguration:
     tbptt_bwd_length: Optional[int] = None
     grad_normalization: Optional[str] = None
     grad_norm_threshold: float = 1.0
+    # layer indices whose parameters never update (TransferLearning /
+    # FrozenLayer); persisted so a restored fine-tune keeps its freeze
+    frozen_layers: List[int] = dataclasses.field(default_factory=list)
 
     # ---- JSON round-trip (DL4J MultiLayerConfiguration.toJson/fromJson) ----
     def to_dict(self) -> Dict[str, Any]:
@@ -205,6 +208,7 @@ class MultiLayerConfiguration:
             "tbptt_bwd_length": self.tbptt_bwd_length,
             "grad_normalization": self.grad_normalization,
             "grad_norm_threshold": self.grad_norm_threshold,
+            "frozen_layers": list(self.frozen_layers),
         }
 
     def to_json(self) -> str:
@@ -223,6 +227,7 @@ class MultiLayerConfiguration:
             tbptt_bwd_length=d.get("tbptt_bwd_length"),
             grad_normalization=d.get("grad_normalization"),
             grad_norm_threshold=d.get("grad_norm_threshold", 1.0),
+            frozen_layers=list(d.get("frozen_layers", [])),
         )
 
     @staticmethod

@@ -103,6 +103,7 @@ class Solver:
         grad_norm_threshold: float = 1.0,
         minimize: bool = True,
         decay_tree=None,
+        trainable_tree=None,
     ):
         self.score_fn = score_fn
         self.updater = updater
@@ -115,6 +116,11 @@ class Solver:
         # regularization (applyLR=true default), distinct from l2 which
         # contributes to the loss.
         self.decay_tree = decay_tree
+        # trainable_tree: pytree of 1.0/0.0 masks matching params —
+        # 0.0 leaves are FROZEN (DL4J FrozenLayer/TransferLearning's
+        # setFeatureExtractor): their update is zeroed after decay, so
+        # the parameter value never moves.
+        self.trainable_tree = trainable_tree
         self._step = jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
 
     def init_opt_state(self, params):
@@ -129,6 +135,13 @@ class Solver:
             loss_of, has_aux=True)(params)
         if not self.minimize:
             loss = -loss  # report the true (maximized) score, not -score
+        if self.trainable_tree is not None:
+            # zero frozen grads BEFORE normalization and the updater:
+            # they must not inflate clip_global_norm or accumulate
+            # momentum/Adam state (DL4J FrozenLayer contributes no
+            # gradients at all)
+            grads = jax.tree_util.tree_map(
+                lambda g, m: g * m, grads, self.trainable_tree)
         grads = normalize_gradients(
             grads, self.grad_normalization, self.grad_norm_threshold)
         updates, opt_state = self.updater.update(grads, opt_state, params, step_idx)
@@ -137,6 +150,11 @@ class Solver:
             updates = jax.tree_util.tree_map(
                 lambda u, p, wd: u + lr * wd * p, updates, params,
                 self.decay_tree)
+        if self.trainable_tree is not None:
+            # updates masked too: weight decay and bias-correction terms
+            # must not move frozen leaves either
+            updates = jax.tree_util.tree_map(
+                lambda u, m: u * m, updates, self.trainable_tree)
         params = jax.tree_util.tree_map(lambda p, u: p - u, params, updates)
         opt_state = self.updater.finalize(opt_state, params)
         return params, opt_state, new_model_state, loss

@@ -1,0 +1,128 @@
+"""TransferLearning builder + frozen layers (the reference's
+``TransferLearning`` / ``FrozenLayer`` fine-tuning workflow)."""
+import numpy as np
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.models.transfer_learning import (
+    TransferLearning, frozen_layer_indices)
+from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                    OutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+
+def _base_model():
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=1e-2))
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=12, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    for _ in range(5):
+        m.fit(DataSet(x, y))
+    return m, x, y
+
+
+def test_feature_extractor_freezes_prefix():
+    m, x, y = _base_model()
+    ft = (TransferLearning.Builder(m)
+          .fine_tune_configuration(updater=Sgd(learning_rate=0.1))
+          .set_feature_extractor(1)          # freeze layers 0..1
+          .build())
+    assert frozen_layer_indices(ft) == [0, 1]
+    w0 = np.asarray(ft.params_tree["layer_0"]["W"]).copy()
+    w1 = np.asarray(ft.params_tree["layer_1"]["W"]).copy()
+    w2 = np.asarray(ft.params_tree["layer_2"]["W"]).copy()
+    # frozen layers carried the TRAINED source params
+    np.testing.assert_array_equal(w0, np.asarray(
+        m.params_tree["layer_0"]["W"]))
+    for _ in range(5):
+        ft.fit(DataSet(x, y))
+    np.testing.assert_array_equal(
+        np.asarray(ft.params_tree["layer_0"]["W"]), w0)   # frozen
+    np.testing.assert_array_equal(
+        np.asarray(ft.params_tree["layer_1"]["W"]), w1)   # frozen
+    assert not np.allclose(
+        np.asarray(ft.params_tree["layer_2"]["W"]), w2)   # head moved
+
+
+def test_n_out_replace_and_new_head_trains():
+    """The classic zoo workflow: swap the head for a new class count,
+    freeze the feature extractor, fine-tune to a working classifier."""
+    m, x, _ = _base_model()
+    rng = np.random.default_rng(1)
+    labels = (x[:, 0] > 0).astype(int)                    # new 2-class task
+    y2 = np.eye(2, dtype=np.float32)[labels]
+    ft = (TransferLearning.Builder(m)
+          .fine_tune_configuration(updater=Adam(learning_rate=5e-3))
+          .set_feature_extractor(0)
+          .remove_output_layer_and_processing()
+          .add_layer(OutputLayer(n_in=12, n_out=2, activation="softmax",
+                                 loss="mcxent"))
+          .build())
+    assert len(ft.layers) == 3
+    first = ft.fit(DataSet(x, y2))
+    for _ in range(40):
+        last = ft.fit(DataSet(x, y2))
+    assert last < 0.5 * first, (first, last)
+    acc = (np.asarray(ft.output(x)).argmax(-1) == labels).mean()
+    assert acc > 0.9, acc
+
+
+def test_n_out_replace_reinitializes_neighbors():
+    m, x, y = _base_model()
+    ft = (TransferLearning.Builder(m)
+          .n_out_replace(1, 20)
+          .build())
+    assert ft.layers[1].n_out == 20
+    assert np.asarray(ft.params_tree["layer_1"]["W"]).shape == (16, 20)
+    assert np.asarray(ft.params_tree["layer_2"]["W"]).shape == (20, 3)
+    # untouched layer 0 keeps source params
+    np.testing.assert_array_equal(
+        np.asarray(ft.params_tree["layer_0"]["W"]),
+        np.asarray(m.params_tree["layer_0"]["W"]))
+    losses = [ft.fit(DataSet(x, y)) for _ in range(5)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_freeze_survives_save_load(tmp_path):
+    """Review regression: the frozen-layer list persists through the
+    serializer, so a restored model keeps its feature extractor
+    frozen."""
+    from deeplearning4j_tpu.utils.model_serializer import (restore_model,
+                                                           write_model)
+    m, x, y = _base_model()
+    ft = (TransferLearning.Builder(m)
+          .set_feature_extractor(0)
+          .build())
+    p = str(tmp_path / "ft.zip")
+    write_model(ft, p)
+    ft2 = restore_model(p)
+    assert frozen_layer_indices(ft2) == [0]
+    w0 = np.asarray(ft2.params_tree["layer_0"]["W"]).copy()
+    for _ in range(3):
+        ft2.fit(DataSet(x, y))
+    np.testing.assert_array_equal(
+        np.asarray(ft2.params_tree["layer_0"]["W"]), w0)
+
+
+def test_source_model_survives_finetune_step():
+    """Review regression: ft params are COPIES — training the
+    transferred model must not invalidate (donate away) the source
+    model's arrays."""
+    m, x, y = _base_model()
+    ft = (TransferLearning.Builder(m)
+          .set_feature_extractor(0)
+          .build())
+    before = np.asarray(m.output(x)).copy()
+    for _ in range(3):
+        ft.fit(DataSet(x, y))
+    np.testing.assert_allclose(np.asarray(m.output(x)), before,
+                               atol=1e-6)
+    m.fit(DataSet(x, y))          # source still trains independently
