@@ -249,6 +249,9 @@ class ComputationGraphConfiguration:
     tbptt_bwd_length: Optional[int] = None
     grad_normalization: Optional[str] = None
     grad_norm_threshold: float = 1.0
+    # layer-vertex names whose parameters never update (TransferLearning
+    # / FrozenLayer); persisted so a restored fine-tune keeps its freeze
+    frozen_layers: List[str] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -265,6 +268,7 @@ class ComputationGraphConfiguration:
             "tbptt_bwd_length": self.tbptt_bwd_length,
             "grad_normalization": self.grad_normalization,
             "grad_norm_threshold": self.grad_norm_threshold,
+            "frozen_layers": list(self.frozen_layers),
         }
 
     def to_json(self) -> str:
@@ -287,6 +291,7 @@ class ComputationGraphConfiguration:
             tbptt_bwd_length=d.get("tbptt_bwd_length"),
             grad_normalization=d.get("grad_normalization"),
             grad_norm_threshold=d.get("grad_norm_threshold", 1.0),
+            frozen_layers=list(d.get("frozen_layers", [])),
         )
         if not conf.topological_order:
             conf.topological_order = _topological_order(
@@ -512,6 +517,13 @@ class ComputationGraph:
                 for pname in ly.regularized_param_names():
                     if get_path(decay_tree[name], pname) is not None:
                         set_path(decay_tree[name], pname, wd)
+        frozen = set(getattr(self.conf, "frozen_layers", ()) or ())
+        trainable = None
+        if frozen:
+            trainable = {
+                name: jax.tree_util.tree_map(
+                    lambda _: 0.0 if name in frozen else 1.0, sub)
+                for name, sub in self.params_tree.items()}
         self._solver = Solver(
             score_fn=self._score_batch,
             updater=self._updater,
@@ -519,6 +531,7 @@ class ComputationGraph:
             grad_norm_threshold=self.conf.grad_norm_threshold,
             minimize=self.conf.global_conf.minimize,
             decay_tree=decay_tree if any_decay else None,
+            trainable_tree=trainable,
         )
         if alloc_opt_state and self.opt_state is None:
             self.opt_state = self._solver.init_opt_state(self.params_tree)

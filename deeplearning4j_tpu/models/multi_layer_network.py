@@ -174,10 +174,8 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     def _trainable_mask(self):
         """0/1 mask pytree from conf.frozen_layers (persisted through
-        save/load) or an explicit _trainable_tree override."""
-        explicit = getattr(self, "_trainable_tree", None)
-        if explicit is not None:
-            return explicit
+        save/load — the ONLY freezing mechanism, so it always
+        survives serialization)."""
         frozen = set(getattr(self.conf, "frozen_layers", ()) or ())
         if not frozen:
             return None

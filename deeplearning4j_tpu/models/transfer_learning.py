@@ -78,11 +78,17 @@ class TransferLearning:
                     f"layer {i} ({type(ly).__name__}) has no n_out")
             ly.n_out = int(n_out)
             self._param_src[i] = None
-            if i + 1 < len(self._layers):
-                nxt = self._layers[i + 1]
+            # Downstream: reset resolved n_in so the rebuild re-infers
+            # shapes, through any non-parameterized layers (pooling /
+            # activation); the FIRST parameterized consumer is the one
+            # whose weights change shape and must re-initialize.
+            for j in range(i + 1, len(self._layers)):
+                nxt = self._layers[j]
                 if hasattr(nxt, "n_in"):
-                    nxt.n_in = int(n_out)
-                self._param_src[i + 1] = None
+                    nxt.n_in = None
+                if nxt.has_params():
+                    self._param_src[j] = None
+                    break
             return self
 
         def remove_output_layer_and_processing(self):
@@ -105,6 +111,19 @@ class TransferLearning:
 
         # -- build ----------------------------------------------------
         def build(self) -> MultiLayerNetwork:
+            if self._freeze_upto >= len(self._layers):
+                raise ValueError(
+                    f"set_feature_extractor({self._freeze_upto}) is out "
+                    f"of range for {len(self._layers)} layers")
+            for i in range(self._freeze_upto + 1):
+                if self._param_src[i] is None and \
+                        self._layers[i].has_params():
+                    raise ValueError(
+                        f"layer {i} is frozen but replaced/added — a "
+                        "fresh random layer inside the feature "
+                        "extractor would never train; lower "
+                        "set_feature_extractor or move the change "
+                        "past it")
             src = self._src
             g = dataclasses.replace(src.conf.global_conf,
                                     **self._global_overrides)
@@ -143,3 +162,22 @@ class TransferLearning:
 def frozen_layer_indices(model: MultiLayerNetwork) -> List[int]:
     """Which layers are frozen (from the persisted conf)."""
     return sorted(getattr(model.conf, "frozen_layers", ()) or ())
+
+
+def freeze_graph_layers(graph, layer_names) -> None:
+    """ComputationGraph freezing (the ``TransferLearning.GraphBuilder``
+    ``setFeatureExtractor`` essential): mark the named layer vertices
+    frozen — persisted in the graph conf, applied as the same update
+    mask the MLN path uses.  Call before the first fit (or rebuild the
+    solver) so the mask reaches the compiled step."""
+    names = [layer_names] if isinstance(layer_names, str) \
+        else list(layer_names)
+    known = set(graph.params_tree)
+    missing = [n for n in names if n not in known]
+    if missing:
+        raise ValueError(
+            f"unknown layer vertices {missing}; parameterized vertices: "
+            f"{sorted(known)}")
+    graph.conf.frozen_layers = sorted(set(
+        list(getattr(graph.conf, "frozen_layers", []) or []) + names))
+    graph._solver = None            # rebuild with the new mask

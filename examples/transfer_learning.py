@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Transfer learning from published zoo weights (the reference's
+``TransferLearning`` + zoo-pretrained flagship workflow): load the
+in-repo LeNet MNIST weights, freeze the convolutional feature
+extractor, swap the 10-class head for a binary one, fine-tune."""
+import numpy as np
+
+from _common import example_args, setup_platform
+
+
+def main():
+    args = example_args(__doc__)
+    setup_platform(args.smoke)
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+    from deeplearning4j_tpu.models.transfer_learning import (
+        TransferLearning, frozen_layer_indices)
+    from deeplearning4j_tpu.nn.conf.layers_core import OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    from deeplearning4j_tpu.zoo import load_pretrained
+
+    m = load_pretrained("LeNet", "mnist")
+    ft = (TransferLearning.Builder(m)
+          .fine_tune_configuration(updater=Adam(learning_rate=1e-3))
+          .set_feature_extractor(len(m.layers) - 3)
+          .remove_output_layer_and_processing()
+          .add_layer(OutputLayer(n_out=2, activation="softmax",
+                                 loss="mcxent"))
+          .build())
+    print("frozen layers:", frozen_layer_indices(ft))
+
+    n = 2000 if args.smoke else 20000
+    it = MnistDataSetIterator(128, n_examples=n, train=True)
+    losses = []
+    for _ in range(2):
+        for ds in it:
+            x = np.asarray(ds.features).reshape(-1, 28, 28, 1)
+            lab = (np.asarray(ds.labels).argmax(-1) < 5).astype(int)
+            losses.append(float(ft.fit(
+                DataSet(x, np.eye(2, dtype=np.float32)[lab]))))
+        it.reset()
+    test = next(iter(MnistDataSetIterator(512, n_examples=512,
+                                          train=False)))
+    xs = np.asarray(test.features).reshape(-1, 28, 28, 1)
+    lab = (np.asarray(test.labels).argmax(-1) < 5).astype(int)
+    acc = (np.asarray(ft.output(xs)).argmax(-1) == lab).mean()
+    print(f"loss {losses[0]:.3f} -> {losses[-1]:.3f}, "
+          f"binary accuracy {acc:.4f}")
+    assert acc > 0.95, acc
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ SCRIPTS = [
     "bert_import_finetune.py",
     "data_parallel_resnet.py",
     "gpt_generate.py",
+    "transfer_learning.py",
 ]
 
 

@@ -126,3 +126,76 @@ def test_source_model_survives_finetune_step():
     np.testing.assert_allclose(np.asarray(m.output(x)), before,
                                atol=1e-6)
     m.fit(DataSet(x, y))          # source still trains independently
+
+
+def test_graph_freeze_and_serialization(tmp_path):
+    """ComputationGraph freezing: masked vertices never move, and the
+    freeze survives the serializer round trip."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.transfer_learning import (
+        freeze_graph_layers)
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.utils.model_serializer import (restore_model,
+                                                           write_model)
+    g = (NeuralNetConfiguration.builder().seed(3)
+         .updater(Adam(learning_rate=1e-2))
+         .graph().add_inputs("in")
+         .set_input_types(InputType.feed_forward(6)))
+    g.add_layer("d1", DenseLayer(n_in=6, n_out=8, activation="relu"),
+                "in")
+    g.add_layer("d2", DenseLayer(n_out=8, activation="tanh"), "d1")
+    g.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"), "d2")
+    from deeplearning4j_tpu.models.computation_graph import (
+        ComputationGraph)
+    model = ComputationGraph(g.set_outputs("out").build()).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    model.fit(DataSet(x, y))
+    freeze_graph_layers(model, ["d1"])
+    w1 = np.asarray(model.params_tree["d1"]["W"]).copy()
+    for _ in range(4):
+        model.fit(DataSet(x, y))
+    np.testing.assert_array_equal(
+        np.asarray(model.params_tree["d1"]["W"]), w1)
+    p = str(tmp_path / "g.zip")
+    write_model(model, p)
+    g2 = restore_model(p)
+    assert g2.conf.frozen_layers == ["d1"]
+    w1b = np.asarray(g2.params_tree["d1"]["W"]).copy()
+    g2.fit(DataSet(x, y))
+    np.testing.assert_array_equal(np.asarray(g2.params_tree["d1"]["W"]),
+                                  w1b)
+
+
+def test_n_out_replace_propagates_through_pooling():
+    """Review regression: changing a conv's n_out must re-infer
+    through non-parameterized layers and reinit the first
+    parameterized consumer (the zoo-CNN headline case)."""
+    from deeplearning4j_tpu.zoo import load_pretrained
+    m = load_pretrained("LeNet", "mnist")
+    conv_idx = next(i for i, ly in enumerate(m.layers)
+                    if type(ly).__name__ == "ConvolutionLayer" and i > 0)
+    ft = (TransferLearning.Builder(m)
+          .n_out_replace(conv_idx, 32)
+          .build())
+    x = np.random.default_rng(0).normal(
+        size=(2, 28, 28, 1)).astype(np.float32)
+    out = np.asarray(ft.output(x))          # forward must not crash
+    assert out.shape[0] == 2
+
+
+def test_freeze_overlap_and_range_rejected():
+    m, _, _ = _base_model()
+    with np.testing.assert_raises(ValueError):
+        (TransferLearning.Builder(m)
+         .set_feature_extractor(10)
+         .build())
+    with np.testing.assert_raises(ValueError):
+        (TransferLearning.Builder(m)
+         .set_feature_extractor(2)           # overlaps the new head
+         .remove_output_layer_and_processing()
+         .add_layer(OutputLayer(n_in=12, n_out=2, activation="softmax",
+                                loss="mcxent"))
+         .build())
