@@ -253,3 +253,19 @@ def test_facenet_center_loss_embedding_trains():
     emb = np.asarray(acts["l2"])
     np.testing.assert_allclose(np.linalg.norm(emb, axis=1),
                                np.ones(len(emb)), atol=1e-5)
+
+
+def test_nasnet_cells_build_and_train():
+    """NASNet-A normal + reduction cell wiring (sep-conv pairs,
+    elementwise adds, block concat) builds and learns."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.zoo import NASNet
+    rng = np.random.default_rng(2)
+    m = NASNet(n_classes=3, input_shape=(32, 32, 3),
+               penultimate_filters=24, n_cells=1, seed=6).init_graph()
+    x = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+    losses = [float(m.fit(DataSet(x, y))) for _ in range(5)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    assert np.asarray(m.output(x)).shape == (4, 3)
