@@ -569,13 +569,40 @@ class SameDiff:
                     child = n.attrs.get(key)
                     if isinstance(child, SameDiff):
                         check_sub(child)
-                if n.op_name == "while_loop" and \
-                        sub_sd._while_static_pattern(n) is None:
+                if n.op_name != "while_loop":
+                    continue
+                pat = sub_sd._while_static_pattern(n)
+                # Inside a parent scan/while body every placeholder is
+                # a TRACER at trace time, so a structurally-matching
+                # loop whose counter init or bound flows in as loop
+                # state still can't resolve a static trip count — it
+                # would fall back to non-differentiable lax.while_loop
+                # and die later with a raw JAX error (ADVICE r4).
+                # Require both to resolve to subgraph CONSTANTs, the
+                # exact condition under which the trip count is
+                # static.  A ("state", j) bound is fine when the
+                # while's j-th input is itself a constant of this
+                # subgraph (a captured constant riding as loop state).
+                def _is_const(name):
+                    v = sub_sd.vars.get(
+                        sub_sd._resolve_ident(sub_sd, name))
+                    return v is not None and v.var_type == "CONSTANT"
+
+                ok = pat is not None and (
+                    pat[1][0] == "const"
+                    or (pat[1][0] == "state"
+                        and pat[1][1] < len(n.inputs)
+                        and _is_const(n.inputs[pat[1][1]])))
+                if ok:
+                    ok = _is_const(n.inputs[pat[0]])
+                if not ok:
                     raise ValueError(
                         f"nested while_loop producing {n.outputs[0]!r} "
                         "inside a control-flow subgraph on the loss "
-                        "path is not scan-convertible; see the "
-                        "while_loop training requirements.")
+                        "path is not scan-convertible (its counter "
+                        "init and bound must be constants of the "
+                        "nested graph); see the while_loop training "
+                        "requirements.")
 
         for node in self.ops:
             if not any(o in needed for o in node.outputs):

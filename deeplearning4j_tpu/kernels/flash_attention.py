@@ -16,15 +16,24 @@ log-sum-exp, so the backward is O(t) memory as well — nothing [t, t]
 ever reaches HBM.  ``delta = rowsum(dO * O)`` is precomputed in XLA
 (one cheap fused reduction).
 
+Mosaic layout discipline (the r5 rewrite — worth 2-4x in-kernel):
+per-row softmax stats (running max / denominator / saved LSE / delta)
+are kept LANE-REPLICATED as [blk_q, 128] f32 tiles, never as 1D
+[blk_q] vectors.  A 1D row-stat vector lives across the LANE dim, so
+broadcasting it back over a [blk_q, blk_k] score tile is a
+lane->sublane relayout (a slow Mosaic shuffle) on every K/V step;
+the replicated form makes every broadcast a cheap lane-tile
+(``jnp.tile(stat, (1, blk_k // 128))``).  The same rule shapes the HBM
+residuals: LSE and delta ride as [bh, t, 128] f32 so the backward
+kernels read them in their compute layout.  Grid dims are annotated
+with ``dimension_semantics`` ("parallel" majors, "arbitrary" minor
+accumulation axis) so Mosaic pipelines block DMA behind compute, and
+sequences that fit one K/V block (t <= blk_k) take a single-step
+kernel with no streaming state at all.
+
 The kernels run identically under ``interpret=True`` (CPU tests) and
 compiled (TPU); ``flash_attention`` picks interpret mode automatically
-off-TPU so one code path serves both.
-
-Measured (TPU v5e, bf16, b=4 h=8 t=4096 d=64, rotating-input timing —
-identical inputs hit a runtime result cache and report fantasy
-numbers): vs XLA's fused attention, forward 4.0 ms vs 7.1 (1.8x),
-forward+backward 6.8 ms vs 13.7 (2.0x), causal forward+backward 6.3 ms
-vs 22.6 (3.6x), at (512, 1024) blocks.  Keep q/k/v in bf16 inside the
+off-TPU so one code path serves both.  Keep q/k/v in bf16 inside the
 kernel: an f32 upcast before the dot_generals runs the MXU at 1/8 rate
 and makes the kernel 4x SLOWER than XLA.
 
@@ -34,6 +43,7 @@ attention — SURVEY.md §2.1 "Pallas only where XLA is weak").
 """
 from __future__ import annotations
 
+import collections
 import functools
 import logging
 from typing import Optional
@@ -49,10 +59,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30   # finite "-inf": keeps the streaming softmax NaN-free
 _POS = 1e30    # lse sentinel for fully-masked rows (=> p == 0 in bwd)
+_LANES = 128   # TPU lane width: stat tiles are [blk_q, _LANES] f32
 
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _dimsem(*sem):
+    return pltpu.CompilerParams(dimension_semantics=sem)
 
 
 def _causal_tile(j, ki, blk_q, blk_k):
@@ -62,6 +77,16 @@ def _causal_tile(j, ki, blk_q, blk_k):
     return cols <= rows
 
 
+def _lane_bcast(stat, width):
+    """[blk_q, 128] lane-replicated stat -> broadcastable to
+    [blk_q, width].  Aligned widths tile whole 128-lane registers (a
+    lane copy); the non-aligned path (interpret mode / d=64) slices,
+    which is correct because every lane holds the same value."""
+    if width % _LANES == 0:
+        return jnp.tile(stat, (1, width // _LANES))
+    return stat[:, :1] if width > _LANES else stat[:, :width]
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -69,7 +94,8 @@ def _fwd_kernel(*refs, n_k: int, scale: float, causal: bool,
                 has_bias: bool):
     """Grid (bh, n_q, n_k): the KV dim is the MINOR grid axis, so each
     K/V block copy double-buffers behind the previous block's compute;
-    the running softmax state lives in VMEM scratch across KV steps."""
+    the running softmax state lives in VMEM scratch across KV steps,
+    lane-replicated [blk_q, 128] (see module docstring)."""
     if has_bias:
         q_ref, k_ref, v_ref, b_ref = refs[:4]
         o_ref, lse_ref, m_ref, l_ref, acc_ref = refs[4:]
@@ -79,6 +105,7 @@ def _fwd_kernel(*refs, n_k: int, scale: float, causal: bool,
         b_ref = None
     j, ki = pl.program_id(1), pl.program_id(2)
     blk_q, blk_k = q_ref.shape[1], k_ref.shape[1]
+    d = q_ref.shape[2]
 
     @pl.when(ki == 0)
     def _init():
@@ -95,24 +122,25 @@ def _fwd_kernel(*refs, n_k: int, scale: float, causal: bool,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [blk_q, blk_k]
         if has_bias:
-            s = s + b_ref[0, 0, :][None, :]
+            s = s + b_ref[0, :1, :]          # [1, blk_k] sublane splat
         if causal:
             s = jnp.where(_causal_tile(j, ki, blk_q, blk_k), s, _NEG)
-        m_prev, l_prev = m_ref[0], l_ref[0]
-        m_new = jnp.maximum(m_prev, s.max(-1))
-        p = jnp.exp(s - m_new[:, None])
-        if causal or has_bias:
-            # where-guard: for a row fully masked so far s == m_new ==
-            # _NEG and exp(0) would contribute phantom mass.  Unmasked
-            # attention can't hit this — skip the elementwise pass.
+        m_prev, l_prev = m_ref[:], l_ref[:]          # [blk_q, 128]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - _lane_bcast(m_new, blk_k))
+        if has_bias:
+            # where-guard: for a row fully padded so far s == m_new ==
+            # _NEG and exp(0) would contribute phantom mass.  Causal
+            # alone can't hit this (ki=0 always gives every row its
+            # diagonal mass) — the guard is bias-only.
             p = jnp.where(s > 0.5 * _NEG, p, 0.0)
-        corr = jnp.exp(m_prev - m_new)
-        m_ref[0] = m_new
-        l_ref[0] = l_prev * corr + p.sum(-1)
+        corr = jnp.exp(m_prev - m_new)               # [blk_q, 128]
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
         pv = lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        acc_ref[:] = acc_ref[:] * corr[:, None] + pv
+        acc_ref[:] = acc_ref[:] * _lane_bcast(corr, d) + pv
 
     if causal:
         # Blocks entirely above the diagonal contribute nothing — skip
@@ -123,15 +151,44 @@ def _fwd_kernel(*refs, n_k: int, scale: float, causal: bool,
 
     @pl.when(ki == n_k - 1)
     def _finish():
-        l = l_ref[0]
+        l = l_ref[:]
         empty = l == 0.0          # fully-masked rows -> zero output
         l_safe = jnp.where(empty, 1.0, l)
-        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
-        lse = jnp.where(empty, _POS, m_ref[0] + jnp.log(l_safe))
-        # LSE rides as [bh, n_q, 8, blk_q] (row replicated over a
-        # sublane-aligned 8) because Mosaic wants the block's trailing
-        # two dims (8, 128)-aligned; squeezed after the call.
-        lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[2:])
+        o_ref[0] = (acc_ref[:] / _lane_bcast(l_safe, d)).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(empty, _POS, m_ref[:] + jnp.log(l_safe))
+
+
+def _fwd_kernel_single(*refs, scale: float, causal: bool,
+                       has_bias: bool):
+    """One K/V block covers the whole row (t <= blk_k): plain softmax,
+    no streaming state, no scratch — grid (bh, n_q)."""
+    if has_bias:
+        q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        b_ref = None
+    j = pl.program_id(1)
+    blk_q, blk_k = q_ref.shape[1], k_ref.shape[1]
+    q, k, v = q_ref[0], k_ref[0], v_ref[0]
+    s = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if has_bias:
+        s = s + b_ref[0, :1, :]
+    if causal:
+        s = jnp.where(_causal_tile(j, 0, blk_q, blk_k), s, _NEG)
+    m = jnp.max(s, axis=1, keepdims=True)            # [blk_q, 1]
+    p = jnp.exp(s - m)
+    if has_bias:
+        p = jnp.where(s > 0.5 * _NEG, p, 0.0)
+    l = jnp.sum(p, axis=1, keepdims=True)            # [blk_q, 1]
+    empty = l == 0.0
+    l_safe = jnp.where(empty, 1.0, l)
+    o_ref[0] = lax.dot_general(
+        (p / l_safe).astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+    lse = jnp.where(empty, _POS, m + jnp.log(l_safe))
+    lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, bias, blk_q: int, blk_k: int, causal: bool,
@@ -139,10 +196,38 @@ def _flash_fwd(q, k, v, bias, blk_q: int, blk_k: int, causal: bool,
     bh, t, d = q.shape
     n_q = pl.cdiv(t, blk_q)
     n_k = pl.cdiv(t, blk_k)
-    grid = (bh, n_q, n_k)
     has_bias = bias is not None
+    qspec = lambda f: pl.BlockSpec((1, blk_q, d), f)
+    if n_k == 1:
+        in_specs = [
+            qspec(lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda i, j: (i, 0, 0)),
+        ]
+        inputs = [q, k, v]
+        if has_bias:
+            in_specs.append(
+                pl.BlockSpec((1, 8, blk_k), lambda i, j: (i, 0, 0)))
+            inputs.append(bias)
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel_single, scale=scale,
+                              causal=causal, has_bias=has_bias),
+            grid=(bh, n_q),
+            in_specs=in_specs,
+            out_specs=[
+                qspec(lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, blk_q, _LANES), lambda i, j: (i, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, t, _LANES), jnp.float32),
+            ],
+            compiler_params=_dimsem("parallel", "parallel"),
+            interpret=_interpret(),
+        )(*inputs)
+        return out, lse
     in_specs = [
-        pl.BlockSpec((1, blk_q, d), lambda i, j, ki: (i, j, 0)),
+        qspec(lambda i, j, ki: (i, j, 0)),
         pl.BlockSpec((1, blk_k, d), lambda i, j, ki: (i, ki, 0)),
         pl.BlockSpec((1, blk_k, d), lambda i, j, ki: (i, ki, 0)),
     ]
@@ -154,24 +239,25 @@ def _flash_fwd(q, k, v, bias, blk_q: int, blk_k: int, causal: bool,
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, n_k=n_k, scale=scale,
                           causal=causal, has_bias=has_bias),
-        grid=grid,
+        grid=(bh, n_q, n_k),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, blk_q, d), lambda i, j, ki: (i, j, 0)),
-            pl.BlockSpec((1, 1, 8, blk_q), lambda i, j, ki: (i, j, 0, 0)),
+            qspec(lambda i, j, ki: (i, j, 0)),
+            pl.BlockSpec((1, blk_q, _LANES), lambda i, j, ki: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, n_q, 8, blk_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, _LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((1, blk_q), jnp.float32),   # running max
-            pltpu.VMEM((1, blk_q), jnp.float32),   # running denom
-            pltpu.VMEM((blk_q, d), jnp.float32),   # output accumulator
+            pltpu.VMEM((blk_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((blk_q, _LANES), jnp.float32),  # running denom
+            pltpu.VMEM((blk_q, d), jnp.float32),       # output accumulator
         ],
+        compiler_params=_dimsem("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*inputs)
-    return out, lse[:, :, 0, :].reshape(bh, t)
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
@@ -179,17 +265,17 @@ def _flash_fwd(q, k, v, bias, blk_q: int, blk_k: int, causal: bool,
 # ---------------------------------------------------------------------------
 def _recompute_p(q_ref, k_ref, b_ref, lse, j, ki, scale, causal,
                  has_bias):
-    """Probability tile from the saved LSE (shared by both bwd kernels).
-    Masked/empty entries underflow exp() to exactly 0."""
+    """Probability tile from the saved [blk_q, 128] LSE (shared by both
+    bwd kernels).  Masked/empty entries underflow exp() to exactly 0."""
     blk_q, blk_k = q_ref.shape[1], k_ref.shape[1]
     s = lax.dot_general(
         q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     if has_bias:
-        s = s + b_ref[0, 0, :][None, :]
+        s = s + b_ref[0, :1, :]
     if causal:
         s = jnp.where(_causal_tile(j, ki, blk_q, blk_k), s, _NEG)
-    return s, jnp.exp(s - lse[:, None])
+    return s, jnp.exp(s - _lane_bcast(lse, blk_k))
 
 
 def _bwd_dkdv_kernel(*refs, n_q: int, scale: float, causal: bool,
@@ -215,8 +301,8 @@ def _bwd_dkdv_kernel(*refs, n_q: int, scale: float, causal: bool,
 
     def _compute():
         do = do_ref[0]
-        lse = lse_ref[0, 0, :]
-        delta = dl_ref[0, 0, :]
+        lse = lse_ref[0]                     # [blk_q, 128]
+        delta = dl_ref[0]                    # [blk_q, 128]
         _, p = _recompute_p(q_ref, k_ref, b_ref, lse, qi, ki, scale,
                             causal, has_bias)
         pb = p.astype(do.dtype)
@@ -226,13 +312,13 @@ def _bwd_dkdv_kernel(*refs, n_q: int, scale: float, causal: bool,
         dp = lax.dot_general(
             do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # dO @ V^T
-        ds_f = p * (dp - delta[:, None])              # dS wrt (s+bias)
+        ds_f = p * (dp - _lane_bcast(delta, blk_k))   # dS wrt (s+bias)
         if has_bias:
             # The bias cotangent rides back through _broadcast8's vjp
             # (a sum over the 8-replicated sublanes) — divide by 8 so
             # that sum reconstructs sum_q(dS) exactly.
             db_acc[:] += jnp.broadcast_to(
-                (ds_f.sum(0) / 8.0)[None, :], db_acc.shape)
+                jnp.sum(ds_f, axis=0, keepdims=True) / 8.0, db_acc.shape)
         ds = (ds_f * scale).astype(do.dtype)
         dk_acc[:] += lax.dot_general(
             ds, q_ref[0], (((0,), (0,)), ((), ())),
@@ -270,14 +356,15 @@ def _bwd_dq_kernel(*refs, n_k: int, scale: float, causal: bool,
 
     def _compute():
         do = do_ref[0]
-        lse = lse_ref[0, 0, :]
-        delta = dl_ref[0, 0, :]
+        lse = lse_ref[0]
+        delta = dl_ref[0]
         _, p = _recompute_p(q_ref, k_ref, b_ref, lse, j, ki, scale,
                             causal, has_bias)
         dp = lax.dot_general(
             do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale).astype(do.dtype)
+        ds = (p * (dp - _lane_bcast(delta, blk_k))
+              * scale).astype(do.dtype)
         dq_acc[:] += lax.dot_general(
             ds, k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # dS @ K
@@ -303,12 +390,15 @@ def _flash_bwd(q, k, v, bias, out, lse, do, blk_q, blk_k, causal, scale):
     n_q = pl.cdiv(t, blk_q)
     n_k = pl.cdiv(t, blk_k)
     has_bias = bias is not None
-    # delta = rowsum(dO * O): one cheap fused XLA reduction, O(t*d) reads.
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), -1)
-    lse8, dl8 = _broadcast8(lse, t), _broadcast8(delta, t)
+    # delta = rowsum(dO * O): one cheap fused XLA reduction, O(t*d)
+    # reads; ride it into the kernels lane-replicated like the LSE.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    -1, keepdims=True)
+    dl = jnp.broadcast_to(delta, (bh, t, _LANES))
 
     qspec = lambda f: pl.BlockSpec((1, blk_q, d), f)
     kspec = lambda f: pl.BlockSpec((1, blk_k, d), f)
+    stspec = lambda f: pl.BlockSpec((1, blk_q, _LANES), f)
 
     # --- dK/dV: grid minor axis = q blocks --------------------------------
     in_specs = [
@@ -316,10 +406,10 @@ def _flash_bwd(q, k, v, bias, out, lse, do, blk_q, blk_k, causal, scale):
         kspec(lambda i, ki, qi: (i, ki, 0)),                   # k
         kspec(lambda i, ki, qi: (i, ki, 0)),                   # v
         qspec(lambda i, ki, qi: (i, qi, 0)),                   # do
-        pl.BlockSpec((1, 8, blk_q), lambda i, ki, qi: (i, 0, qi)),  # lse
-        pl.BlockSpec((1, 8, blk_q), lambda i, ki, qi: (i, 0, qi)),  # delta
+        stspec(lambda i, ki, qi: (i, qi, 0)),                  # lse
+        stspec(lambda i, ki, qi: (i, qi, 0)),                  # delta
     ]
-    inputs = [q, k, v, do, lse8, dl8]
+    inputs = [q, k, v, do, lse, dl]
     out_specs = [kspec(lambda i, ki, qi: (i, ki, 0)),
                  kspec(lambda i, ki, qi: (i, ki, 0))]
     out_shape = [jax.ShapeDtypeStruct((bh, t, d), k.dtype),
@@ -343,6 +433,7 @@ def _flash_bwd(q, k, v, bias, out, lse, do, blk_q, blk_k, causal, scale):
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=scratch,
+        compiler_params=_dimsem("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*inputs)
     dk, dv = outs[0], outs[1]
@@ -354,10 +445,10 @@ def _flash_bwd(q, k, v, bias, out, lse, do, blk_q, blk_k, causal, scale):
         kspec(lambda i, j, ki: (i, ki, 0)),
         kspec(lambda i, j, ki: (i, ki, 0)),
         qspec(lambda i, j, ki: (i, j, 0)),
-        pl.BlockSpec((1, 8, blk_q), lambda i, j, ki: (i, 0, j)),
-        pl.BlockSpec((1, 8, blk_q), lambda i, j, ki: (i, 0, j)),
+        stspec(lambda i, j, ki: (i, j, 0)),
+        stspec(lambda i, j, ki: (i, j, 0)),
     ]
-    inputs = [q, k, v, do, lse8, dl8]
+    inputs = [q, k, v, do, lse, dl]
     if has_bias:
         in_specs.append(
             pl.BlockSpec((1, 8, blk_k), lambda i, j, ki: (i, 0, ki)))
@@ -370,6 +461,7 @@ def _flash_bwd(q, k, v, bias, out, lse, do, blk_q, blk_k, causal, scale):
         out_specs=qspec(lambda i, j, ki: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        compiler_params=_dimsem("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*inputs)
     return dq, dk, dv, dbias8
@@ -386,11 +478,17 @@ def _flash(q, k, v, bias, blk_q, blk_k, causal, scale):
 
 def _flash_vjp_fwd(q, k, v, bias, blk_q, blk_k, causal, scale):
     out, lse = _flash_fwd(q, k, v, bias, blk_q, blk_k, causal, scale)
-    return out, (q, k, v, bias, out, lse)
+    # Keep the residual compact ([bh, t] — lane 0 of the replicated
+    # tile); the backward re-broadcasts to the kernel's [bh, t, 128]
+    # layout in XLA, trading one cheap materialization per bwd call
+    # for 128x less residual memory held across the forward pass.
+    return out, (q, k, v, bias, out, lse[:, :, 0])
 
 
 def _flash_vjp_bwd(blk_q, blk_k, causal, scale, res, do):
-    q, k, v, bias, out, lse = res
+    q, k, v, bias, out, lse_small = res
+    lse = jnp.broadcast_to(lse_small[:, :, None],
+                           (*lse_small.shape, _LANES))
     dq, dk, dv, dbias8 = _flash_bwd(q, k, v, bias, out, lse, do, blk_q,
                                     blk_k, causal, scale)
     # dbias8 flows back through _fold_bias's broadcasts (jax sums the
@@ -441,12 +539,13 @@ def flash_attention(q, k, v, blk_q: int = 512, blk_k: int = 512, *,
         raise ValueError(
             f"sequence length {t} must be divisible by block sizes "
             f"({blk_q}, {blk_k})")
-    if bias is not None and blk_k % 128 and not _interpret():
-        # Mosaic lowering constraint: the bias block (1, 8, blk_k)
-        # needs a lane-aligned trailing dim on real TPU hardware
-        # (interpret mode has no such restriction).
+    if blk_k % _LANES and not _interpret():
+        # Mosaic layout constraint: the [blk_q, 128] lane-replicated
+        # stats broadcast over score tiles by whole-register lane
+        # tiling, and the (1, 8, blk_k) bias block needs a lane-aligned
+        # trailing dim (interpret mode has no such restriction).
         raise ValueError(
-            f"bias requires blk_k % 128 == 0 on TPU (got {blk_k}); "
+            f"flash requires blk_k % 128 == 0 on TPU (got {blk_k}); "
             "use attention() for automatic routing")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
@@ -461,24 +560,26 @@ def flash_attention(q, k, v, blk_q: int = 512, blk_k: int = 512, *,
 # block per (batch*head) and XLA's batched fused attention wins —
 # measured on BERT-base training (v5e): t=256 XLA 52.6% MFU vs flash
 # 43.2%; t=512 flash 48.2% vs XLA 41.4%.  attention() auto-routes.
-# Confirmed by the r4 crossover sweep (FLASH_SWEEP_r04.json, fwd+bwd,
-# d in {64,128}, causal/bias on and off): flash 1.11-1.89x XLA at
-# t>=512, 0.79-0.95x at t=256 — the 512 threshold holds across every
-# measured head dim / mask combination.
+# The r4 sweep's plain-variant (no-mask) rows showing flash 0.02-0.39x
+# XLA were a measurement artifact: the plain config was always the
+# first timed loop after fresh buffer allocation, which the axon
+# tunnel poisons (diagnosed r5 — scripts/diag_plain_flash.py shows
+# plain == bias == causal ms with proper warm-up).  FLASH_SWEEP_r05
+# re-measures every variant with the differential two-scan-length
+# protocol (kernel inside lax.scan, fixed tunnel costs cancel), which
+# shows flash ahead of XLA at every t >= 512 variant including plain.
 _FLASH_MIN_T = 512
 
 
 def _auto_blocks(t: int, causal: bool = False):
-    """Measured-best blocks: (512, 1024) when they tile t, else the
-    largest legal fallback (single block for short sequences).  For
-    causal the r4 block sweep at t=2048 prefers (512, 512)
-    (10.13 ms vs 10.46 ms fwd+bwd) — smaller k-blocks waste less work
-    on diagonal tiles."""
-    bq = 512 if t % 512 == 0 else t
-    if causal:
-        bk = 512 if t % 512 == 0 else t
-    else:
-        bk = 1024 if t % 1024 == 0 else (512 if t % 512 == 0 else t)
+    """Measured-best blocks (FLASH_SWEEP_r05 causal_t2048_block_sweep,
+    differential scan protocol at t=2048/d=128 fwd+bwd): q-block 1024,
+    k-block 512 = 2.389 ms — best of the 3x3 grid (next: (1024,1024)
+    2.82, (512,1024) 3.01, (512,512) 3.11, worst (256,256) 5.94).
+    Falls back to the largest tiling block (single-step kernel when one
+    K/V block covers the row)."""
+    bq = 1024 if t % 1024 == 0 else (512 if t % 512 == 0 else t)
+    bk = 512 if t % 512 == 0 else t
     return min(bq, t), min(bk, t)
 
 
@@ -489,15 +590,13 @@ def _flash_applicable(q, k, bias, blk_q, blk_k) -> bool:
     if t < _FLASH_MIN_T:             # XLA wins at short t (see above)
         return False
     bq, bk = min(blk_q, t), min(blk_k, t)
-    if t % bq or t % bk or t % 8:
+    if t % bq or t % bk or t % 8 or bk % _LANES:
         return False
     if max(bq, bk) > 1024:
         # a non-tiling t would clamp to one giant [t, t] block and
         # blow VMEM at compile time — fall back instead
         return False
     if bias is not None:
-        if bk % 128:                 # Mosaic bias-block lane alignment
-            return False
         bias = jnp.asarray(bias)
         if bias.ndim == 4 and bias.shape[2] != 1:
             return False             # query-dependent bias
@@ -543,16 +642,20 @@ def xla_attention(q, k, v, bias=None, causal: bool = False,
 # are appended at TRACE time — reset, force a fresh trace (new shapes
 # or cleared jit cache), then inspect.  A cached executable records
 # nothing: the log answers "what did the last compilation choose".
-_ROUTE_LOG: list = []
+# Bounded (last 256 traces) so long-lived serving processes that
+# retrace many shapes don't grow it without end; appends are not
+# thread-safe — treat the log as a single-threaded debugging probe,
+# not a production counter (ADVICE r4).
+_ROUTE_LOG: collections.deque = collections.deque(maxlen=256)
 
 
 def reset_route_log() -> None:
-    del _ROUTE_LOG[:]
+    _ROUTE_LOG.clear()
 
 
 def route_log() -> tuple:
     """Tuple of ('flash'|'xla', t, d) per attention() trace since the
-    last reset."""
+    last reset (bounded at the last 256 entries)."""
     return tuple(_ROUTE_LOG)
 
 

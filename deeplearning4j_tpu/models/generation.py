@@ -130,6 +130,13 @@ class TransformerGenerator:
         L = max_len or total
         if L < total:
             raise ValueError(f"max_len {L} < prompt+new {total}")
+        if self.emb.add_positional and L > self.emb.max_len:
+            # past the table, dynamic_slice would silently clamp and
+            # every later position would reuse the LAST positional row
+            raise ValueError(
+                f"generation length {L} exceeds the model's positional "
+                f"table ({self.emb.max_len} rows); re-configure "
+                "EmbeddingSequenceLayer.max_len or shorten the request")
         key = (b, t0, n_new, L, float(temperature))
         if key not in self._fn_cache:
             self._fn_cache[key] = jax.jit(

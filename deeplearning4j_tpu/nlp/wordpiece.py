@@ -105,6 +105,13 @@ class BertWordPieceTokenizerFactory:
         the LONGER segment, the PAIR on ties) and padded to
         ``max_len`` when given."""
         v = self.vocab
+        if max_len is not None:
+            floor = 2 if pair is None else 3
+            if max_len < floor:
+                raise ValueError(
+                    f"max_len={max_len} cannot fit the special tokens "
+                    f"([CLS]/[SEP] framing needs >= {floor} positions "
+                    f"{'with a pair' if pair else ''})")
         conv = lambda toks: [v[t] for t in toks]
         a = self.tokenize(text)
         if pair is None:

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Diagnose the FLASH_SWEEP_r04 plain-variant anomaly (VERDICT r5 ask 2):
+flash with causal=False, bias=None timed ~50 ms FLAT across shapes whose
+total input bytes are constant but whose FLOPs vary 8x — honest kernel
+time tracks FLOPs, so something per-call and size-proportional is wrong.
+Hypotheses: (a) per-call recompilation, (b) degenerate Mosaic schedule,
+(c) host transfer / sync forced only on the no-mask path.
+
+Probes, at d=128 t=2048 b=8 h=6 (flagship-adjacent):
+  1. log_compiles on — count compiles across the timed loop per variant
+  2. fwd-only vs fwd+bwd per variant
+  3. plain fwd with jax.profiler trace → count device kernels
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+fa = None
+
+
+def timed(fn, bufs, iters=20, tag=""):
+    out = fn(*bufs[0])
+    jax.block_until_ready(out)
+    for a in bufs:
+        out = fn(*a)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = fn(*bufs[i % len(bufs)])
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    _ = float(jnp.sum(leaf.astype(jnp.float32)))
+    ms = (time.perf_counter() - t0) / iters * 1e3
+    print(f"  {tag}: {ms:.2f} ms/iter", flush=True)
+    return ms
+
+
+def main():
+    global fa
+    import deeplearning4j_tpu.kernels  # noqa: F401
+    fa = sys.modules["deeplearning4j_tpu.kernels.flash_attention"]
+    assert jax.default_backend() == "tpu"
+    rng = np.random.default_rng(0)
+    b, h, t, d = 8, 6, 2048, 128
+    mk = lambda: jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.bfloat16)
+    bufs = [(mk(), mk(), mk()) for _ in range(4)]
+    bias = jnp.zeros((b, 1, 1, t), jnp.float32)
+    blocks = fa._auto_blocks(t)
+    print("blocks:", blocks)
+
+    # throwaway first loop (poisoned through the tunnel)
+    f_warm = jax.jit(lambda q, k, v: fa.xla_attention(q, k, v))
+    timed(f_warm, bufs, tag="warmup-xla (discard)")
+
+    variants = {
+        "plain fwd": jax.jit(lambda q, k, v: fa.flash_attention(
+            q, k, v, *blocks)),
+        "bias fwd": jax.jit(lambda q, k, v: fa.flash_attention(
+            q, k, v, *blocks, bias=bias)),
+        "causal fwd": jax.jit(lambda q, k, v: fa.flash_attention(
+            q, k, v, *blocks, causal=True)),
+    }
+    for tag, fn in variants.items():
+        timed(fn, bufs, tag=tag)
+
+    def g(fn):
+        return jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+
+    gvariants = {
+        "plain fwd+bwd": g(lambda q, k, v: fa.flash_attention(
+            q, k, v, *blocks)),
+        "bias fwd+bwd": g(lambda q, k, v: fa.flash_attention(
+            q, k, v, *blocks, bias=bias)),
+    }
+    for tag, fn in gvariants.items():
+        timed(fn, bufs, tag=tag)
+
+    # compile-count probe: re-time plain fwd with log_compiles
+    print("\n-- log_compiles probe (plain fwd, 6 calls) --", flush=True)
+    import logging
+    logging.basicConfig(level=logging.WARNING)
+    with jax.log_compiles(True):
+        fn = variants["plain fwd"]
+        for i in range(6):
+            t0 = time.perf_counter()
+            out = fn(*bufs[i % 4])
+            jax.block_until_ready(out)
+            print(f"  call {i}: {(time.perf_counter()-t0)*1e3:.2f} ms",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -90,3 +90,15 @@ def test_gpt_trains_sparse_labels():
         last = net.fit(ds)
     assert np.isfinite(last)
     assert last < first, (first, last)
+
+
+def test_generate_rejects_beyond_positional_table():
+    # ADVICE r4: past the table, dynamic_slice would clamp silently and
+    # reuse the last positional row — must raise instead.
+    net = _tiny_gpt()          # max_len=32 positional rows
+    gen = TransformerGenerator(net)
+    prompt = np.zeros((1, 4), np.int32)
+    with pytest.raises(ValueError, match="positional table"):
+        gen.generate(prompt, n_new=40)
+    with pytest.raises(ValueError, match="positional table"):
+        gen.generate(prompt, n_new=2, max_len=64)

@@ -150,3 +150,15 @@ def test_mlm_always_selects_at_least_one(vocab_file):
     ds = next(iter(it))
     sel = np.asarray(ds.labels[1])
     assert (sel.sum(axis=1) >= 1).all()
+
+
+def test_encode_degenerate_max_len_raises(vocab_file):
+    # ADVICE r4: max_len too small for [CLS]/[SEP] framing must raise
+    # instead of producing over-long ids / popping an empty list.
+    tok = BertWordPieceTokenizerFactory(vocab_file)
+    with pytest.raises(ValueError, match="max_len"):
+        tok.encode("the quick fox", max_len=1)
+    with pytest.raises(ValueError, match="max_len"):
+        tok.encode("the quick", pair="lazy dog", max_len=2)
+    ids, mask, tt = tok.encode("the", max_len=2)
+    assert len(ids) == 2
