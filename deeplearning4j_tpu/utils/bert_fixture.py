@@ -56,8 +56,27 @@ def attach_classifier_head(sd, n_classes: int = 2, seed: int = 0):
     if "loss" in sd.vars:
         return
     pooled = sd.vars["Identity_1"]
+    # imported vars carry no static shapes — walk back from the pooler
+    # output to the nearest constant (its dense bias) for the hidden
+    # size (768 on BERT-base, 64 on the tiny test fixture)
+    prod = {o: n for n in sd.ops for o in n.outputs}
+    dim, frontier = None, ["Identity_1"]
+    for _ in range(6):
+        if dim is not None:
+            break
+        nxt = []
+        for nm in frontier:
+            val = sd.values.get(nm)
+            if val is not None and getattr(val, "ndim", 0) >= 1:
+                dim = int(np.asarray(val).shape[-1])
+                break
+            node = prod.get(nm)
+            if node is not None:
+                nxt.extend(node.inputs)
+        frontier = nxt
+    dim = dim or 768
     w = sd.var("cls_W", np.random.default_rng(seed).normal(
-        scale=0.02, size=(768, n_classes)).astype(np.float32))
+        scale=0.02, size=(dim, n_classes)).astype(np.float32))
     b = sd.var("cls_b", np.zeros(n_classes, np.float32))
     logits = sd.op("add", sd.matmul(pooled, w), b, name="logits")
     labels = sd.placeholder("labels", (None,), "int32")

@@ -1,0 +1,34 @@
+"""Tiny frozen BERT with vocab 1536 (>= the tiny_sentiment corpus's
+1171-entry WordPiece vocab) for the config-4 quality test at CPU
+scale — the shared bert_tiny_frozen.pb keeps vocab 500 and its
+goldens untouched."""
+import os
+os.environ["CUDA_VISIBLE_DEVICES"] = ""
+os.environ["TRANSFORMERS_NO_ADVISORY_WARNINGS"] = "1"
+import numpy as np
+import tensorflow as tf
+
+OUT = os.path.dirname(os.path.abspath(__file__))
+from transformers import BertConfig, TFBertModel
+
+cfg = BertConfig(vocab_size=1536, hidden_size=64, num_hidden_layers=2,
+                 num_attention_heads=4, intermediate_size=128,
+                 max_position_embeddings=64, type_vocab_size=2)
+tf.random.set_seed(1)
+model = TFBertModel(cfg)
+B, T = 2, 16
+ids = np.random.default_rng(0).integers(0, 1536, (B, T)).astype(np.int32)
+mask = np.ones((B, T), np.int32); mask[1, 10:] = 0
+tt = np.zeros((B, T), np.int32)
+_ = model(input_ids=ids, attention_mask=mask, token_type_ids=tt)
+
+from tensorflow.python.framework.convert_to_constants import convert_variables_to_constants_v2
+fn = tf.function(lambda i, m, t: model(input_ids=i, attention_mask=m, token_type_ids=t))
+conc = fn.get_concrete_function(
+    tf.TensorSpec((None, T), tf.int32), tf.TensorSpec((None, T), tf.int32),
+    tf.TensorSpec((None, T), tf.int32))
+frozen = convert_variables_to_constants_v2(conc)
+gd = frozen.graph.as_graph_def()
+with open(os.path.join(OUT, "bert_tiny_sentiment_frozen.pb"), "wb") as f:
+    f.write(gd.SerializeToString())
+print("GEN OK", len(gd.node))
