@@ -129,8 +129,17 @@ register_op("cast")(lambda x, dtype: (np.asarray(x).astype(dtype)
 # Matmul family — the MXU path
 # ---------------------------------------------------------------------------
 @register_op("matmul")
-def _matmul(a, b, transpose_a=False, transpose_b=False):
-    """2-D+ matmul (``Mmul``/TF MatMul/BatchMatMulV2 in one: jnp batches)."""
+def _matmul(a, b, transpose_a=False, transpose_b=False, expect_k=None):
+    """2-D+ matmul (``Mmul``/TF MatMul/BatchMatMulV2 in one: jnp batches).
+
+    ``expect_k`` is set by ``rewrites.fold_flatten_reshapes``, which
+    removed a flattening reshape on ``a``: when the contraction axis is
+    already innermost (every TF Tensordot over the last axis) the
+    operand rides through rank-3 untouched and jnp batches the dot; in
+    any other case re-applying the flatten here reproduces the dropped
+    reshape exactly, so the fold is semantics-identical either way."""
+    if expect_k is not None and a.shape[-1] != expect_k:
+        a = jnp.reshape(a, (-1, expect_k))
     if transpose_a:
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:

@@ -493,7 +493,151 @@ def optimize_for_tpu(sd: SameDiff,
         "layer_norm": fuse_layer_norm(sd),
         "gelu": fuse_gelu(sd),
         "attention": fuse_attention(sd, compute_dtype=compute_dtype),
+        # last: operates on the matmuls the passes above left unfused
+        "flatten_reshapes": fold_flatten_reshapes(sd),
     }
+
+
+# Ops that treat the last axis identically at any rank — a fold that
+# changes a tensor from [b*t, n] to [b, t, n] commutes with these.
+_RANK_POLY = frozenset(("bias_add", "add", "identity", "mul", "split",
+                        "gelu", "tanh", "relu"))
+
+
+def fold_flatten_reshapes(sd: SameDiff) -> int:
+    """Drop TF Tensordot's 2D-ification reshape in front of matmuls.
+
+    tf.Tensordot (every Keras Dense on rank-3 input — the frozen BERT
+    emits one per FF/projection layer) lowers ``x @ W`` as
+    ``transpose -> reshape(x, [prod(lead), k]) -> MatMul -> reshape
+    back``.  ``jnp.matmul`` contracts rank-3 @ rank-2 natively, and the
+    measured cost of the sandwich is real: the imported train step
+    carries +293 stablehlo reshapes vs the equivalent zoo model, and
+    ROOFLINE r4 attributes +23% HBM bytes to exactly this fusion-
+    boundary scaffolding.
+
+    Only the INPUT-side reshape is dropped, which is semantics-
+    preserving without any shape proof: (a) the reshape must flatten to
+    a 2-element target (const or Tensordot's pack) — the folded matmul
+    carries ``expect_k`` (W's contraction size) and re-applies the
+    flatten at trace time unless the contraction axis is already
+    innermost, so the fold is exactly the original computation in
+    every case; and (b) every consumer path from the matmul reaches a
+    computed reshape through rank-polymorphic ops only (reshape(y, s)
+    gives identical results for any rank of y — same elements, same
+    row-major order, same target — so the downstream reshape
+    re-normalizes the shape and itself folds to a no-op when the target
+    equals the new natural shape).  Returns the number of folds."""
+    maps = _Maps(sd)
+    # the REAL graph outputs, captured before folding orphans anything
+    # (post-fold, an orphaned reshape is indistinguishable from a
+    # terminal output by the no-consumers heuristic)
+    protected = (set(sd.outputs or ()) | set(sd.loss_variables)
+                 | set(maps.graph_outputs))
+    folds = 0
+    for n in sd.ops:
+        if n.op_name != "matmul" or n.attrs.get("transpose_a"):
+            continue
+        pi, r1 = _producer(sd, maps, n.inputs[0])
+        if r1 is None or r1.op_name != "reshape" or \
+                not _single_consumer(maps, sd, r1.outputs[0]):
+            continue
+        # contraction size from the parameter operand — possibly a
+        # column-concat of params (fuse_parallel_matmuls' fused qkv)
+        k = None
+        wname = _resolve_param_leaf(sd, maps, n.inputs[1])
+        if wname is not None:
+            w = np.asarray(sd.values[wname])
+            if w.ndim == 2:
+                k = int(w.shape[1] if n.attrs.get("transpose_b")
+                        else w.shape[0])
+        else:
+            _, wc = _producer(sd, maps, n.inputs[1])
+            if wc is not None and wc.op_name == "concat" \
+                    and not n.attrs.get("transpose_b"):
+                # axis rides as an attr on our fused concat, as the
+                # trailing input on an imported TF ConcatV2
+                if "axis" in wc.attrs:
+                    axis, wins = int(wc.attrs["axis"]), wc.inputs
+                else:
+                    axis, wins = _scalar_const(sd, wc.inputs[-1]), \
+                        wc.inputs[:-1]
+                leaves = [_resolve_param_leaf(sd, maps, p)
+                          for p in wins]
+                if axis in (1, -1) and all(l is not None for l in leaves):
+                    shapes = {np.asarray(sd.values[l]).shape
+                              for l in leaves}
+                    if all(len(s) == 2 for s in shapes) and \
+                            len({s[0] for s in shapes}) == 1:
+                        k = int(next(iter(shapes))[0])
+        if k is None:
+            continue
+        # the reshape must flatten to a 2-element target: a constant
+        # [m|-1, k] vector, or Tensordot's pack(Prod, Prod_1) (both
+        # dims computed dynamically — trace-time expect_k handles it)
+        sname = r1.inputs[1]
+        two_elem = False
+        sval = sd.values.get(sname)
+        if sval is not None:
+            two_elem = np.asarray(sval).reshape(-1).size == 2
+        else:
+            _, sn = _producer(sd, maps, sname)
+            two_elem = (sn is not None and sn.op_name == "pack"
+                        and len(sn.inputs) == 2)
+        if not two_elem:
+            continue
+        # every consumer path must reach a reshape via rank-poly ops
+        ok, frontier, hops = True, [n.outputs[0]], 0
+        while frontier and hops < 8:
+            hops += 1
+            nxt = []
+            for o in frontier:
+                cons = maps.consumers.get(o, [])
+                if not cons or o in maps.graph_outputs \
+                        or o in (sd.outputs or ()):
+                    ok = False
+                    break
+                for ci in cons:
+                    cn = sd.ops[ci]
+                    if cn.op_name == "reshape":
+                        continue        # re-normalizes: path closed
+                    if cn.op_name not in _RANK_POLY:
+                        ok = False
+                        break
+                    nxt.extend(cn.outputs)
+                if not ok:
+                    break
+            if not ok:
+                break
+            frontier = nxt
+        if not ok or frontier:
+            continue
+        # fold: matmul consumes r1's input directly; trace-time guard
+        n.inputs[0] = r1.inputs[0]
+        n.attrs["expect_k"] = k
+        folds += 1
+        maps = _Maps(sd)                # consumer map changed
+    if folds:
+        # orphaned reshapes (and their shape-math chains) are pruned
+        # by the needed-set at trace time; drop them from the op list
+        # too (to fixpoint) so op counts reflect the graph that runs
+        while True:
+            maps = _Maps(sd)
+            live = []
+            for i, n in enumerate(sd.ops):
+                if any(maps.consumers.get(o) or o in protected
+                       for o in n.outputs):
+                    live.append(i)
+            if len(live) == len(sd.ops):
+                break
+            keep = set(live)
+            for i, n in enumerate(sd.ops):
+                if i not in keep:
+                    for o in n.outputs:
+                        sd.vars.pop(o, None)
+            sd.ops = [n for i, n in enumerate(sd.ops) if i in keep]
+        sd._fn_cache.clear()
+    return folds
 
 
 def _looks_attention_shaped(sd: SameDiff) -> bool:

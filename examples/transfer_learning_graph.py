@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""DAG-side transfer learning (the reference's
+``TransferLearning.GraphBuilder`` workflow): graph-ify the published
+LeNet MLN weights (``mln_to_graph`` = upstream
+``MultiLayerNetwork#toComputationGraph``), freeze the convolutional
+featurizer by VERTEX name (ancestor closure), remove the 10-class
+output vertex, attach a binary head, and fine-tune — plus the
+``TransferLearningHelper`` featurizer split for cached-activation
+head training."""
+import numpy as np
+
+from _common import example_args, setup_platform
+
+
+def main():
+    args = example_args(__doc__)
+    setup_platform(args.smoke)
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+    from deeplearning4j_tpu.models.transfer_learning import (
+        GraphBuilder, TransferLearningHelper, mln_to_graph)
+    from deeplearning4j_tpu.nn.conf.layers_core import OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    from deeplearning4j_tpu.zoo import load_pretrained
+
+    graph = mln_to_graph(load_pretrained("LeNet", "mnist"))
+    n_layers = len(graph.conf.topological_order)
+    boundary = f"layer_{n_layers - 3}"
+    ft = (GraphBuilder(graph)
+          .set_feature_extractor(boundary)
+          .remove_vertex_and_connections(f"layer_{n_layers - 1}")
+          .add_layer("binary", OutputLayer(n_out=2, activation="softmax",
+                                           loss="mcxent"),
+                     f"layer_{n_layers - 2}")
+          .set_outputs("binary")
+          .fine_tune_configuration(updater=Adam(learning_rate=3e-3))
+          .build())
+    print("frozen vertices:", ft.conf.frozen_layers)
+
+    n = 512 if args.smoke else 8000
+    it = MnistDataSetIterator(64, n_examples=n, train=True)
+    xs, labels = [], []
+    for ds in it:
+        xs.append(np.asarray(ds.features).reshape(-1, 28, 28, 1))
+        labels.append((np.asarray(ds.labels).argmax(-1) < 5).astype(int))
+    x = np.concatenate(xs)
+    y = np.eye(2, dtype=np.float32)[np.concatenate(labels)]
+    split = int(0.75 * len(x))
+    epochs = 40 if args.smoke else 12
+    for _ in range(epochs):
+        ft.fit(DataSet(x[:split], y[:split]))
+    pred = np.argmax(np.asarray(ft.output(x[split:])), -1)
+    acc = (pred == y[split:].argmax(-1)).mean()
+    print(f"held-out binary accuracy after fine-tune: {acc:.3f}")
+
+    # featurizer split: frozen activations once, head-style reuse
+    feats = TransferLearningHelper(ft, boundary).featurize(x[:8])
+    print("featurized batch:", np.asarray(feats).shape)
+    assert acc > 0.85, acc
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

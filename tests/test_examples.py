@@ -18,6 +18,7 @@ SCRIPTS = [
     "data_parallel_resnet.py",
     "gpt_generate.py",
     "transfer_learning.py",
+    "transfer_learning_graph.py",
 ]
 
 
