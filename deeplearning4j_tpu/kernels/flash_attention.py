@@ -516,6 +516,12 @@ def _fold_bias(bias, b, h, t):
                 "general fallback")
         bias = bias[:, :, 0, :]          # [b, h|1, tk]
     elif bias.ndim == 2:
+        if bias.shape[0] != b:
+            raise ValueError(
+                f"2-D flash bias must be [batch, t_k] (got "
+                f"{tuple(bias.shape)} for batch {b}); a [t_q, t_k] "
+                "mask is query-dependent — pass causal=True for the "
+                "triangular case or use attention()'s XLA fallback")
         bias = bias[:, None, :]          # [b, 1, tk]
     bias = jnp.broadcast_to(bias, (b, h, t)).reshape(b * h, t)
     return _broadcast8(bias, t)
@@ -600,6 +606,16 @@ def _flash_applicable(q, k, bias, blk_q, blk_k) -> bool:
         bias = jnp.asarray(bias)
         if bias.ndim == 4 and bias.shape[2] != 1:
             return False             # query-dependent bias
+        b = q.shape[0]
+        if bias.ndim == 2 and bias.shape[0] != b:
+            # a [tq, tk] mask is query-dependent, not the [b, tk]
+            # key-position form — and when b == t the two are
+            # indistinguishable by shape, so the routing contract is
+            # strictly "dim 0 is batch" (callers with triangular
+            # masks should pass causal=True instead)
+            return False
+        if bias.ndim == 3 and bias.shape[0] != b:
+            return False
     return True
 
 

@@ -1,0 +1,80 @@
+"""Toy causal decoder (GPT-style) frozen TF graph for the
+imported-causal-mask routing tests: Keras Dense projections (Tensordot
+idiom), multi-head split, scores + ADDITIVE tril-constant causal mask,
+softmax, probs @ V — the standard imported-GPT masking shape.
+t=512 so the imported graph is flash-eligible on TPU."""
+import os
+os.environ["CUDA_VISIBLE_DEVICES"] = ""
+os.environ["TF_ENABLE_ONEDNN_OPTS"] = "0"
+import numpy as np
+import tensorflow as tf
+
+OUT = os.path.dirname(os.path.abspath(__file__))
+V, T, D, H, L = 500, 512, 64, 2, 2
+DH = D // H
+MASK = ((1.0 - np.tril(np.ones((T, T), np.float32))) * -1e9)
+
+
+class Block(tf.keras.layers.Layer):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.wq = tf.keras.layers.Dense(D, use_bias=True)
+        self.wk = tf.keras.layers.Dense(D, use_bias=True)
+        self.wv = tf.keras.layers.Dense(D, use_bias=True)
+        self.wo = tf.keras.layers.Dense(D, use_bias=True)
+        self.ln1 = tf.keras.layers.LayerNormalization(epsilon=1e-5)
+        self.ln2 = tf.keras.layers.LayerNormalization(epsilon=1e-5)
+        self.ff1 = tf.keras.layers.Dense(2 * D, activation="gelu")
+        self.ff2 = tf.keras.layers.Dense(D)
+
+    def call(self, x):
+        h = self.ln1(x)
+        b = tf.shape(h)[0]
+        def split(t):    # [b, T, D] -> [b, H, T, DH]
+            t = tf.reshape(t, (b, T, H, DH))
+            return tf.transpose(t, (0, 2, 1, 3))
+        q, k, v = split(self.wq(h)), split(self.wk(h)), split(self.wv(h))
+        s = tf.matmul(q, k, transpose_b=True) / float(np.sqrt(DH))
+        s = s + tf.constant(MASK)
+        p = tf.nn.softmax(s, axis=-1)
+        o = tf.matmul(p, v)              # [b, H, T, DH]
+        o = tf.reshape(tf.transpose(o, (0, 2, 1, 3)), (b, T, D))
+        x = x + self.wo(o)
+        return x + self.ff2(self.ff1(self.ln2(x)))
+
+
+class ToyGpt(tf.keras.Model):
+    def __init__(self):
+        super().__init__()
+        self.emb = tf.keras.layers.Embedding(V, D)
+        self.pos = tf.Variable(
+            np.random.default_rng(0).normal(0, 0.02, (T, D)).astype(
+                np.float32))
+        self.blocks = [Block() for _ in range(L)]
+        self.lnf = tf.keras.layers.LayerNormalization(epsilon=1e-5)
+
+    def call(self, ids):
+        x = self.emb(ids) + self.pos[None]
+        for blk in self.blocks:
+            x = blk(x)
+        return self.lnf(x)               # [b, T, D] last hidden
+
+
+tf.random.set_seed(3)
+model = ToyGpt()
+ids = np.random.default_rng(1).integers(0, V, (2, T)).astype(np.int32)
+out = model(ids)
+
+from tensorflow.python.framework.convert_to_constants import (
+    convert_variables_to_constants_v2)
+fn = tf.function(lambda i: model(i))
+conc = fn.get_concrete_function(tf.TensorSpec((None, T), tf.int32))
+frozen = convert_variables_to_constants_v2(conc)
+gd = frozen.graph.as_graph_def()
+print("inputs:", [t.name for t in frozen.inputs])
+print("outputs:", [t.name for t in frozen.outputs])
+with open(os.path.join(OUT, "gpt_toy_frozen.pb"), "wb") as f:
+    f.write(gd.SerializeToString())
+np.savez(os.path.join(OUT, "gpt_toy_golden.npz"), ids=ids,
+         last_hidden=out.numpy())
+print("GEN OK", len(gd.node))
