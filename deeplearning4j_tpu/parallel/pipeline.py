@@ -110,9 +110,16 @@ def gpipe_apply(mesh: Mesh, stacked_params, x, block_apply: Callable,
                             + outs.shape[2:])
 
     x_spec = P(data_axis) if data_axis else P()
+    # only the pipe (and data) axes are MANUAL; any other mesh axis
+    # ('model', 'sequence') stays auto-partitioned, so GSPMD places
+    # tensor-parallel collectives INSIDE the stage body from the
+    # operands' shardings — this is what lets DP x TP x PP compose
+    # through one shard_map (VERDICT r4 item 7)
+    manual = {axis} | ({data_axis} if data_axis else set())
     out = jax.shard_map(
         worker, mesh=mesh,
-        in_specs=(P(axis), x_spec), out_specs=x_spec)(stacked_params, x)
+        in_specs=(P(axis), x_spec), out_specs=x_spec,
+        axis_names=frozenset(manual))(stacked_params, x)
     return out
 
 

@@ -81,18 +81,59 @@ def _param_spec(path, shape, tp: int, shardable: dict):
     return P()
 
 
+def _find_block_run(model):
+    """Longest run of conf-identical TransformerEncoderBlocks in an
+    MLN's layer list — the sub-stack MeshConfig.pipeline shards.
+    Returns (lo, hi) or None."""
+    import dataclasses
+    from deeplearning4j_tpu.nn.conf.layers_transformer import (
+        TransformerEncoderBlock)
+    layers = getattr(model, "layers", None)
+    if layers is None:
+        return None
+    best, i = None, 0
+    while i < len(layers):
+        if isinstance(layers[i], TransformerEncoderBlock):
+            ref = dataclasses.asdict(layers[i])
+            j = i
+            while j < len(layers) and \
+                    isinstance(layers[j], TransformerEncoderBlock) and \
+                    dataclasses.asdict(layers[j]) == ref:
+                j += 1
+            if best is None or j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        else:
+            i += 1
+    return best if best is not None and best[1] - best[0] >= 2 else None
+
+
 class ShardedTrainer:
     """Drives a MultiLayerNetwork/ComputationGraph's solver step under a
     mesh.  ``fit_batch`` is the hot path; ``fit`` drives an iterator like
-    ``ParallelWrapper.fit`` did."""
+    ``ParallelWrapper.fit`` did.
+
+    ``MeshConfig.pipeline > 1`` (MLN with a homogeneous
+    TransformerEncoderBlock run) swaps the middle of the step for the
+    GPipe schedule: the run's parameters restack onto a
+    pipe-axis-sharded leading dim, ``gpipe_apply`` runs the schedule,
+    and DP/TP compose on the remaining mesh axes (TP stays
+    auto-partitioned by GSPMD inside the stage body).  The model's own
+    params tree is refreshed (unstacked) after every ``fit``/
+    ``fit_batch`` so ``output``/checkpointing keep working."""
 
     def __init__(self, model, mesh_conf: Optional[MeshConfig] = None,
-                 devices=None):
+                 devices=None, n_micro: int = 4):
         self.model = model
         self.mesh_conf = mesh_conf or MeshConfig.data_parallel()
         self.mesh = self.mesh_conf.build(devices)
         self.tp = self.mesh_conf.model
+        self.n_micro = n_micro
         model._check_init()
+        if self.mesh_conf.pipeline > 1:
+            self._init_pipelined()
+            return
+        self._pipe = None
         model._build_solver()
         self.solver = model._solver
 
@@ -117,6 +158,184 @@ class ShardedTrainer:
             model.state_tree,
             jax.tree_util.tree_map(lambda a: self._replicated,
                                    model.state_tree))
+    # -- pipeline path (MeshConfig.pipeline > 1) -----------------------
+    def _init_pipelined(self):
+        import dataclasses
+        from deeplearning4j_tpu.nn.conf.layers_core import BaseOutputLayerConf
+        from deeplearning4j_tpu.parallel.pipeline import gpipe_apply
+
+        model, S = self.model, self.mesh_conf.pipeline
+        run = _find_block_run(model)
+        if run is None:
+            raise ValueError(
+                "MeshConfig.pipeline > 1 needs a MultiLayerNetwork "
+                "with a run of >= 2 conf-identical "
+                "TransformerEncoderBlocks to shard into stages")
+        lo, hi = run
+        if (hi - lo) % S:
+            raise ValueError(
+                f"{hi - lo} pipelined blocks do not divide over "
+                f"{S} stages")
+        if getattr(model.conf, "frozen_layers", None):
+            raise ValueError("pipeline path does not support frozen "
+                             "layers yet")
+        if model.conf.backprop_type != "standard":
+            raise ValueError("pipeline path supports standard backprop "
+                             "only (no tBPTT)")
+        if not isinstance(model.layers[-1], BaseOutputLayerConf):
+            raise ValueError("last layer must be an output layer")
+        drop = getattr(model.layers[lo], "dropout", 0) or 0
+        if drop:
+            log.warning("pipelined blocks run without dropout "
+                        "(configured rate %.3g)", drop)
+        self._pipe = (lo, hi)
+        blocks = [model.params_tree[f"layer_{i}"] for i in range(lo, hi)]
+        stacked = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *blocks)
+
+        tp, mesh = self.tp, self.mesh
+        tp_rules = {"Wqkv": "col", "W1": "col", "W2": "row", "Wo": "row"}
+
+        def stacked_spec(path, a):
+            key = getattr(path[-1], "key", str(path[-1]))
+            kind = tp_rules.get(key)
+            if tp > 1 and kind and np.ndim(a) == 3:
+                if kind == "col" and a.shape[-1] % tp == 0:
+                    return P("pipeline", None, "model")
+                if kind == "row" and a.shape[1] % tp == 0:
+                    return P("pipeline", "model", None)
+            return P("pipeline")
+
+        shardable = _tp_shardable_layers(model)
+
+        def outer_spec(name):
+            def f(path, a):
+                keys = [getattr(p, "key", str(p)) for p in path]
+                kind = shardable.get(name, {}).get(keys[-1])
+                if tp > 1 and kind and np.ndim(a) == 2:
+                    if kind == "col" and a.shape[-1] % tp == 0:
+                        return P(None, "model")
+                    if kind == "row" and a.shape[0] % tp == 0:
+                        return P("model", None)
+                return P()
+            return f
+
+        # copies, not views: the jitted step DONATES its params, and
+        # donated aliases of the model's own tree would delete them
+        cp = lambda t: jax.tree_util.tree_map(jnp.array, t)
+        pre = {f"layer_{i}": cp(model.params_tree[f"layer_{i}"])
+               for i in range(lo)}
+        post = {f"layer_{i}": cp(model.params_tree[f"layer_{i}"])
+                for i in range(hi, len(model.layers))}
+        params = {"pre": pre, "blocks": stacked, "post": post}
+
+        def place(tree, spec_fn):
+            return jax.device_put(tree, jax.tree_util.tree_map_with_path(
+                lambda p, a: NamedSharding(mesh, spec_fn(p, a)), tree))
+
+        params["blocks"] = place(params["blocks"], stacked_spec)
+        for part in ("pre", "post"):
+            for name in params[part]:
+                params[part][name] = place(params[part][name],
+                                           outer_spec(name))
+        self._pipe_params = params
+        self._updater = model._updater
+        self._pipe_opt = self._updater.init_state(params)
+
+        layers, confs = model.layers, model.conf
+        block_conf = layers[lo]
+        out_layer = layers[-1]
+        n_micro = self.n_micro
+        d_axis = "data" if self.mesh_conf.data > 1 else None
+        compute_dtype = model._compute_dtype
+        state0 = {k: dict(v) for k, v in model.state_tree.items()}
+
+        def apply_outer(p, i, x):
+            prep = confs.preprocessors[i]
+            if prep is not None:
+                x = prep(x)
+            y, _ = layers[i].apply(p[f"layer_{i}"],
+                                   state0[f"layer_{i}"], x,
+                                   training=False,
+                                   compute_dtype=compute_dtype)
+            return y
+
+        def loss_fn(params, batch):
+            x, labels = batch["features"], batch["labels"]
+            for i in range(lo):
+                x = apply_outer(params["pre"], i, x)
+            x = gpipe_apply(
+                mesh, params["blocks"], x,
+                lambda p, a: block_conf.apply(
+                    p, {}, a, training=False,
+                    compute_dtype=compute_dtype)[0],
+                n_micro, axis="pipeline", data_axis=d_axis)
+            for i in range(hi, len(layers) - 1):
+                x = apply_outer(params["post"], i, x)
+            prep = confs.preprocessors[-1]
+            if prep is not None:
+                x = prep(x)
+            last = f"layer_{len(layers) - 1}"
+            z = out_layer.pre_output(params["post"][last], x,
+                                     compute_dtype)
+            scores = out_layer.per_example_score(
+                labels, z, None, head_input=x,
+                params=params["post"][last])
+            return jnp.mean(scores) + self._pipe_reg(params)
+
+        def step(params, opt_state, it, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = self._updater.update(
+                grads, opt_state, params, it)
+            params = jax.tree_util.tree_map(
+                lambda p, u: p - u, params, updates)
+            opt_state = self._updater.finalize(opt_state, params)
+            return params, opt_state, loss
+
+        self._pipe_step = jax.jit(step, donate_argnums=(0, 1))
+
+    def _pipe_reg(self, params):
+        """l1/l2 over all layers from the TRACED params — a sum over a
+        stacked-blocks leaf equals the per-layer sums it replaces, so
+        the run is counted exactly once (at i == lo)."""
+        model, reg = self.model, 0.0
+        (lo, hi) = self._pipe
+        from deeplearning4j_tpu.utils.trees import get_path
+        for i, ly in enumerate(model.layers):
+            l1 = ly.l1 or 0.0
+            l2 = ly.l2 or 0.0
+            if not (l1 or l2):
+                continue
+            if lo < i < hi:
+                continue                 # run counted once, at i == lo
+            for name in ly.regularized_param_names():
+                if i == lo:
+                    w = get_path(params["blocks"], name)
+                else:
+                    part = "pre" if i < lo else "post"
+                    w = get_path(params[part][f"layer_{i}"], name)
+                if w is None:
+                    continue
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(w))
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(jnp.square(w))
+        return reg
+
+    def sync_model(self):
+        """Unstack the pipelined params back into the model's tree so
+        ``output``/serialization see the trained weights."""
+        if self._pipe is None:
+            return
+        lo, hi = self._pipe
+        m = self.model
+        p = self._pipe_params
+        for name, tree in {**p["pre"], **p["post"]}.items():
+            m.params_tree[name] = tree
+        for j in range(hi - lo):
+            m.params_tree[f"layer_{lo + j}"] = jax.tree_util.tree_map(
+                lambda a, _j=j: a[_j], p["blocks"])
+
     def _shard_batch(self, batch: dict) -> dict:
         """Place every batch leaf (arrays, possibly nested per-input dicts
         for multi-input graphs) batch-sharded over the 'data' axis."""
@@ -132,6 +351,18 @@ class ShardedTrainer:
         """Run the compiled sharded step on a prepared batch dict WITHOUT
         touching counters."""
         m = self.model
+        if self._pipe is not None:
+            if "features_mask" in batch or "labels_mask" in batch:
+                raise ValueError("pipeline path does not support "
+                                 "masked batches yet")
+            batch = self._shard_batch(
+                {"features": batch["features"],
+                 "labels": batch["labels"]})
+            with self.mesh:
+                (self._pipe_params, self._pipe_opt, loss) = \
+                    self._pipe_step(self._pipe_params, self._pipe_opt,
+                                    m.iteration_count, batch)
+            return loss
         batch = self._shard_batch(batch)
         with self.mesh:
             (m.params_tree, m.opt_state, m.state_tree, loss) = \
@@ -155,10 +386,13 @@ class ShardedTrainer:
         round — except synchronization is an XLA all-reduce over ICI."""
         loss = self._step_batch(features, labels, features_mask, labels_mask)
         self.model.iteration_count += 1
+        self.sync_model()
         return loss
 
     def fit(self, iterator, n_epochs: int = 1):
         """Drive an iterator through the sharded step — the same shared
         epoch loop as MultiLayerNetwork/ComputationGraph.fit, so tBPTT,
         MultiDataSet batches, listener ordering and counters agree."""
-        return run_fit(self.model, iterator, n_epochs, self._step_dict)
+        out = run_fit(self.model, iterator, n_epochs, self._step_dict)
+        self.sync_model()
+        return out

@@ -122,3 +122,77 @@ def test_dp_x_pp_composition_trains_and_matches():
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
     acc = (lm.predict(ids.astype(np.int32)).argmax(-1) == labels).mean()
     assert acc > 0.8, acc
+
+
+# ---------------------------------------------------------------------------
+# Round-5 (VERDICT r4 item 7): MeshConfig.pipeline consumed by
+# ShardedTrainer for CONFIG-BUILT models — no bespoke class — and
+# DP x TP x PP composing through one shard_map (TP auto-partitioned
+# inside the stage body).
+# ---------------------------------------------------------------------------
+
+def _tiny_gpt_model(seed=11):
+    from deeplearning4j_tpu.zoo.gpt import Gpt
+    return Gpt(vocab_size=64, max_len=16, d_model=32, n_layers=4,
+               n_heads=4, d_ff=64, seq_len=16, compute_dtype=None,
+               use_flash=False, seed=seed).init_graph()
+
+
+def _lm_batch(rng, b=16, t=16, v=64):
+    x = rng.integers(0, v, (b, t)).astype(np.int32)
+    y = np.roll(x, -1, axis=1)
+    return x, y
+
+
+@pytest.mark.parametrize("mesh_kw", [
+    dict(pipeline=2),                       # pure PP
+    dict(data=2, pipeline=2),               # DP x PP
+    dict(data=2, model=2, pipeline=2),      # DP x TP x PP — 3 axes
+])
+def test_sharded_trainer_pipeline_axis_matches_single_device(mesh_kw):
+    """A config-built zoo.Gpt trains through ShardedTrainer with a
+    pipeline axis; its loss trajectory matches the SAME model trained
+    unsharded (identical init/data) to float tolerance."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel.trainer import (MeshConfig,
+                                                     ShardedTrainer)
+
+    rng = np.random.default_rng(3)
+    x, y = _lm_batch(rng)
+    ds = DataSet(x, y)
+
+    ref = _tiny_gpt_model()
+    ref_losses = [float(ref.fit(ds)) for _ in range(4)]
+
+    model = _tiny_gpt_model()               # identical init (same seed)
+    st = ShardedTrainer(model, MeshConfig(**mesh_kw), n_micro=2)
+    losses = [float(st.fit_batch(x, y)) for _ in range(4)]
+
+    assert np.isfinite(losses).all()
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-3, atol=2e-3)
+    # trained weights flowed back into the model's own tree
+    out = model.output(x)
+    assert np.isfinite(np.asarray(out)).all()
+    w_pipe = np.asarray(model.params_tree["layer_1"]["Wqkv"])
+    w_ref = np.asarray(ref.params_tree["layer_1"]["Wqkv"])
+    np.testing.assert_allclose(w_pipe, w_ref, rtol=5e-3, atol=5e-3)
+
+
+def test_sharded_trainer_pipeline_validations():
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                        OutputLayer)
+    from deeplearning4j_tpu.parallel.trainer import (MeshConfig,
+                                                     ShardedTrainer)
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(DenseLayer(n_in=8, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    m = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError, match="TransformerEncoderBlock"):
+        ShardedTrainer(m, MeshConfig(pipeline=2))
+    gpt = _tiny_gpt_model()                 # 4 blocks
+    with pytest.raises(ValueError, match="divide"):
+        ShardedTrainer(gpt, MeshConfig(pipeline=3))
