@@ -5,10 +5,11 @@ cache).
 
 TPU-first design: generation is ONE jitted ``lax.scan`` over time with
 static shapes — the KV caches are preallocated [b, h, max_len, dh]
-buffers written via ``lax.dynamic_update_slice``, prompt prefill and
-sampling ride the same scan (a step consumes the prompt token while
-``t < len(prompt)``, its own sample after) — so the whole decode is a
-single XLA program, no per-token Python dispatch or retrace.
+buffers written via ``lax.dynamic_update_slice``, the prompt prefills
+in ONE batched causal forward (matmul-rate, not the per-step
+params-bandwidth floor), and sampling scans one token per tick — the
+whole decode is a single XLA program, no per-token Python dispatch or
+retrace.
 
 Works over any MultiLayerNetwork whose stack is
 ``EmbeddingSequenceLayer -> N x TransformerEncoderBlock(causal=True)
@@ -74,11 +75,75 @@ def _block_decode_step(ly: TransformerEncoderBlock, params, kcache,
     return y, kcache, vcache
 
 
+def _embed_prompt(ly: EmbeddingSequenceLayer, params, ids):
+    """[b, t0] int prompt -> [b, t0, d] (positions 0..t0-1)."""
+    y = jnp.take(params["W"], ids.astype(jnp.int32), axis=0)
+    if ly.add_positional:
+        y = y + params["P"][: ids.shape[1]][None]
+    if ly.layer_norm:
+        y = _layer_norm(y, params["g"], params["b"], ly.eps)
+    return y
+
+
+def _block_prefill(ly: TransformerEncoderBlock, params, x):
+    """Whole-prompt causal forward for one block: x [b, t, d] ->
+    (y [b, t, d], k [b, h, t, dh], v) — ONE batched pass instead of t
+    cached single-token steps, so prefill runs at matmul rate instead
+    of the per-step params-bandwidth floor.  Same math (f32 scores,
+    -1e9 mask) as ``_block_decode_step``."""
+    b, t, d = x.shape
+    h, dh = ly.n_heads, d // ly.n_heads
+    cast = lambda w: w.astype(x.dtype)
+    qkv = x @ cast(params["Wqkv"]) + cast(params["bqkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split = lambda z: z.reshape(b, t, h, dh).transpose(0, 2, 1, 3)
+    q, k, v = split(q), split(k), split(v)
+    scale = 1.0 / (dh ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    rows = jnp.arange(t)[:, None]
+    cols = jnp.arange(t)[None, :]
+    s = jnp.where((cols <= rows)[None, None], s, -1e9)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    att = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    att = att.transpose(0, 2, 1, 3).reshape(b, t, d)
+    att = att @ cast(params["Wo"]) + cast(params["bo"])
+    hdn = _layer_norm(x + att, params["ln1_g"], params["ln1_b"], ly.eps)
+    from deeplearning4j_tpu.nn.activations import get_activation
+    act = get_activation(ly.activation or "gelu")
+    ffn = act(hdn @ cast(params["W1"]) + cast(params["b1"]))
+    ffn = ffn @ cast(params["W2"]) + cast(params["b2"])
+    y = _layer_norm(hdn + ffn, params["ln2_g"], params["ln2_b"], ly.eps)
+    return y, k, v
+
+
+def _filter_logits(logits, top_k, top_p):
+    """Nucleus/top-k filtering on [b, V] logits (already
+    temperature-scaled): outside-the-set entries go to -inf."""
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        srt = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        # drop tokens whose preceding cumulative mass already covers p
+        # (the top token always survives)
+        cut = (csum - probs) >= float(top_p)
+        srt = jnp.where(cut, jnp.inf, srt)
+        thresh = jnp.min(srt, axis=-1, keepdims=True)
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return logits
+
+
 class TransformerGenerator:
-    """Greedy / temperature sampling with KV caches over a decoder MLN.
+    """Greedy / temperature / top-k / nucleus sampling with KV caches
+    over a decoder MLN.  The prompt is prefilled in ONE batched causal
+    forward (matmul-rate), then decode scans one token at a time.
 
     >>> gen = TransformerGenerator(net)
     >>> out = gen.generate(prompt_ids, n_new=64)      # [b, t0+64]
+    >>> out = gen.generate(prompt_ids, n_new=64, temperature=0.8,
+    ...                    top_k=40, top_p=0.95)
     """
 
     def __init__(self, net, compute_dtype: Optional[str] = None):
@@ -121,9 +186,12 @@ class TransformerGenerator:
         return logits, new_caches
 
     def generate(self, prompt_ids, n_new: int, temperature: float = 0.0,
-                 seed: int = 0, max_len: Optional[int] = None):
+                 seed: int = 0, max_len: Optional[int] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None):
         """[b, t0] int prompt -> [b, t0 + n_new].  temperature == 0 is
-        greedy argmax; > 0 samples logits/temperature."""
+        greedy argmax; > 0 samples logits/temperature, optionally
+        filtered to the top-k tokens and/or the top-p nucleus."""
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         b, t0 = prompt_ids.shape
         total = t0 + n_new
@@ -137,11 +205,16 @@ class TransformerGenerator:
                 f"generation length {L} exceeds the model's positional "
                 f"table ({self.emb.max_len} rows); re-configure "
                 "EmbeddingSequenceLayer.max_len or shorten the request")
-        key = (b, t0, n_new, L, float(temperature))
+        if (top_k is not None or top_p is not None) and temperature <= 0:
+            raise ValueError("top_k/top_p need temperature > 0 "
+                             "(greedy ignores the filtered tail)")
+        key = (b, t0, n_new, L, float(temperature), top_k,
+               None if top_p is None else float(top_p))
         if key not in self._fn_cache:
             self._fn_cache[key] = jax.jit(
                 lambda e, bl, h, ids, k: self._generate_scan(
-                    e, bl, h, ids, k, t0, n_new, L, temperature))
+                    e, bl, h, ids, k, t0, n_new, L, temperature,
+                    top_k, top_p))
         emb_p, blk_ps, head_p = self._params()
         ids = jnp.concatenate(
             [prompt_ids, jnp.zeros((b, n_new), jnp.int32)], axis=1)
@@ -149,33 +222,69 @@ class TransformerGenerator:
                                   jax.random.PRNGKey(seed))
         return np.asarray(out)
 
-    def _generate_scan(self, emb_p, blk_ps, head_p, ids, rng_key,
-                       t0, n_new, L, temperature):
-        b = ids.shape[0]
+    def _prefill(self, emb_p, blk_ps, head_p, prompt, L):
+        """Batched prompt pass: fill every block's KV cache for
+        positions < t0 and return the last position's logits."""
+        b, t0 = prompt.shape
         dh = self.emb.n_out // self.blocks[0].n_heads
         h = self.blocks[0].n_heads
-        caches = [(jnp.zeros((b, h, L, dh), self.compute_dtype),
-                   jnp.zeros((b, h, L, dh), self.compute_dtype))
-                  for _ in self.blocks]
+        x = _embed_prompt(self.emb, emb_p, prompt)
+        x = x.astype(self.compute_dtype)
+        caches = []
+        for ly, p in zip(self.blocks, blk_ps):
+            x, k, v = _block_prefill(ly, p, x)
+            kc = jnp.zeros((b, h, L, dh), self.compute_dtype)
+            vc = jnp.zeros((b, h, L, dh), self.compute_dtype)
+            kc = jax.lax.dynamic_update_slice(
+                kc, k.astype(self.compute_dtype), (0, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v.astype(self.compute_dtype), (0, 0, 0, 0))
+            caches.append((kc, vc))
+        last = x[:, -1].astype(jnp.float32)
+        logits = last @ head_p["W"] + head_p["b"]
+        return logits, caches
 
-        def body(carry, pos):
-            ids, caches, key = carry
-            tok = jax.lax.dynamic_slice_in_dim(ids, pos, 1, axis=1)[:, 0]
-            logits, caches = self._step(emb_p, blk_ps, head_p, caches,
-                                        tok, pos)
+    def _generate_scan(self, emb_p, blk_ps, head_p, ids, rng_key,
+                       t0, n_new, L, temperature, top_k=None,
+                       top_p=None):
+        if self.compute_dtype != jnp.float32:
+            # cast the full parameter set ONCE inside the program: the
+            # decode scan re-reads every parameter each tick, and
+            # streaming f32-stored weights costs 2x the bytes of the
+            # bf16 math actually performed (measured 840 -> 969
+            # steps/s on zoo.Gpt; the tick also carries per-op
+            # overheads the byte halving cannot remove)
+            cast = lambda t: jax.tree_util.tree_map(
+                lambda a: (a.astype(self.compute_dtype)
+                           if jnp.issubdtype(a.dtype, jnp.floating)
+                           else a), t)
+            emb_p, blk_ps, head_p = cast(emb_p), cast(blk_ps), \
+                cast(head_p)
+        prompt = ids[:, :t0]
+        logits0, caches = self._prefill(emb_p, blk_ps, head_p, prompt,
+                                        L)
+
+        def sample(logits, key):
             if temperature > 0.0:
                 key, sub = jax.random.split(key)
-                nxt = jax.random.categorical(
-                    sub, logits / temperature, axis=-1)
+                lg = _filter_logits(logits / temperature, top_k, top_p)
+                nxt = jax.random.categorical(sub, lg, axis=-1)
             else:
                 nxt = jnp.argmax(logits, axis=-1)
-            nxt = nxt.astype(jnp.int32)
-            # keep the prompt: only write positions >= t0
-            cur = jax.lax.dynamic_slice_in_dim(ids, pos + 1, 1, axis=1)
-            write = jnp.where(pos + 1 >= t0, nxt[:, None], cur)
-            ids = jax.lax.dynamic_update_slice(ids, write, (0, pos + 1))
-            return (ids, caches, key), None
+            return nxt.astype(jnp.int32), key
 
-        (ids, _, _), _ = jax.lax.scan(
-            body, (ids, caches, rng_key), jnp.arange(t0 + n_new - 1))
+        def body(carry, pos):
+            # sample the token AT pos from the previous logits, write
+            # it, embed it, advance the caches
+            ids, caches, key, logits = carry
+            nxt, key = sample(logits, key)
+            ids = jax.lax.dynamic_update_slice(ids, nxt[:, None],
+                                               (0, pos))
+            logits, caches = self._step(emb_p, blk_ps, head_p, caches,
+                                        nxt, pos)
+            return (ids, caches, key, logits), None
+
+        (ids, _, _, _), _ = jax.lax.scan(
+            body, (ids, caches, rng_key, logits0),
+            t0 + jnp.arange(n_new))
         return ids

@@ -102,3 +102,43 @@ def test_generate_rejects_beyond_positional_table():
         gen.generate(prompt, n_new=40)
     with pytest.raises(ValueError, match="positional table"):
         gen.generate(prompt, n_new=2, max_len=64)
+
+
+def test_top_k_and_top_p_filtering():
+    from deeplearning4j_tpu.models.generation import _filter_logits
+    import jax.numpy as jnp
+    lg = jnp.asarray([[1.0, 3.0, 2.0, -1.0]])
+    k2 = np.asarray(_filter_logits(lg, 2, None))
+    assert np.isneginf(k2[0, 0]) and np.isneginf(k2[0, 3])
+    assert k2[0, 1] == 3.0 and k2[0, 2] == 2.0
+    # nucleus: top token survives even with tiny p
+    p_small = np.asarray(_filter_logits(lg, None, 1e-6))
+    assert p_small[0, 1] == 3.0
+    assert np.isneginf(p_small[0, [0, 2, 3]]).all()
+    # p ~ 1 keeps everything
+    p_all = np.asarray(_filter_logits(lg, None, 0.9999))
+    assert np.isfinite(p_all).all()
+
+
+def test_top_k_1_matches_greedy():
+    net = _tiny_gpt()
+    gen = TransformerGenerator(net)
+    prompt = np.random.default_rng(5).integers(0, 50, (2, 4)).astype(
+        np.int32)
+    greedy = gen.generate(prompt, n_new=6)
+    k1 = gen.generate(prompt, n_new=6, temperature=0.7, top_k=1)
+    np.testing.assert_array_equal(greedy, k1)
+    with pytest.raises(ValueError, match="temperature"):
+        gen.generate(prompt, n_new=2, top_k=5)
+
+
+def test_top_p_sampling_stays_in_nucleus():
+    net = _tiny_gpt()
+    gen = TransformerGenerator(net)
+    prompt = np.random.default_rng(6).integers(0, 50, (2, 4)).astype(
+        np.int32)
+    out = gen.generate(prompt, n_new=8, temperature=1.0, top_p=0.9,
+                       seed=1)
+    assert out.shape == (2, 12)
+    assert (out >= 0).all() and (out < 50).all()
+    np.testing.assert_array_equal(out[:, :4], prompt)
