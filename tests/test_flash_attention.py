@@ -231,3 +231,53 @@ def test_self_attention_layer_flash_flag_parity():
     m_ein, m_flash = build(False), build(True)
     np.testing.assert_allclose(np.asarray(m_flash.output(x)),
                                np.asarray(m_ein.output(x)), atol=3e-5)
+
+
+def test_bthd_layout_matches_bhtd_fwd_and_grad():
+    """layout='bthd' reads [b, t, h, d] in place: outputs and all
+    gradients must match the transposed bhtd call exactly."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.kernels import flash_attention
+    rng = np.random.default_rng(0)
+    b, h, t, d = 2, 3, 64, 16
+    mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    bias = jnp.asarray(
+        np.where(rng.random((b, t)) < 0.2, -1e9, 0.0), jnp.float32)
+    for kw in ({}, {"causal": True}, {"bias": bias},
+               {"causal": True, "bias": bias}):
+        o_bthd = flash_attention(q, k, v, 16, 16, layout="bthd", **kw)
+        o_ref = flash_attention(
+            q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
+            16, 16, **kw).swapaxes(1, 2)
+        np.testing.assert_allclose(np.asarray(o_bthd),
+                                   np.asarray(o_ref), atol=2e-5)
+
+        def loss(fn, args, lay):
+            return jnp.sum(flash_attention(
+                *args, 16, 16, layout=lay, **kw).astype(jnp.float32)
+                ** 2)
+        g1 = jax.grad(lambda a: loss(None, a, "bthd"))((q, k, v))
+        g2 = jax.grad(lambda a: loss(None, a, "bhtd"))(
+            tuple(x.swapaxes(1, 2) for x in (q, k, v)))
+        for a, bb in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a),
+                                       np.asarray(bb.swapaxes(1, 2)),
+                                       atol=2e-4)
+
+
+def test_attention_bthd_routes_and_falls_back():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import kernels
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(2, 64, 2, 16)), jnp.float32)
+    kernels.reset_route_log()
+    out = kernels.attention(q, q, q, causal=True, layout="bthd")
+    assert out.shape == (2, 64, 2, 16)
+    assert kernels.route_log() == (("xla", 64, 16),)  # t<512 -> xla
+    ref = kernels.attention(q.swapaxes(1, 2), q.swapaxes(1, 2),
+                            q.swapaxes(1, 2), causal=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(ref.swapaxes(1, 2)),
+                               atol=2e-5)

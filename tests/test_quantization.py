@@ -1,0 +1,85 @@
+"""Post-training int8 weight quantization (the reference dtype zoo's
+quantized-inference corner): per-channel symmetric int8 weights with
+dequantize-in-jit — accuracy within tolerance of f32, ~4x weight
+compression, works for MLN and ComputationGraph."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.runtime.quantization import (QuantizedInference,
+                                                     quantize_leaf)
+
+
+def test_quantize_leaf_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = rng.normal(scale=0.3, size=(64, 32)).astype(np.float32)
+    q, s = quantize_leaf(w)
+    assert q.dtype == np.int8 and s.shape == (32,)
+    deq = q.astype(np.float32) * s
+    # symmetric 127-level: error <= scale/2 per channel
+    assert (np.abs(w - deq) <= s[None, :] * 0.5 + 1e-7).all()
+
+
+def test_pretrained_lenet_int8_accuracy_holds():
+    from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+    from deeplearning4j_tpu.zoo import load_pretrained
+
+    model = load_pretrained("LeNet", "mnist")
+    qi = QuantizedInference(model)
+    assert qi.compression_ratio() > 3.5, qi.compression_ratio()
+    assert qi.max_abs_weight_error() < 0.02
+
+    it = MnistDataSetIterator(256, n_examples=1000, train=False)
+    hits_f = hits_q = total = 0
+    for ds in it:
+        x = np.asarray(ds.features).reshape(-1, 28, 28, 1)
+        y = np.argmax(np.asarray(ds.labels), -1)
+        pf = np.argmax(np.asarray(model.output(x)), -1)
+        pq = np.argmax(np.asarray(qi.output(x)), -1)
+        hits_f += int((pf == y).sum())
+        hits_q += int((pq == y).sum())
+        total += len(y)
+    acc_f, acc_q = hits_f / total, hits_q / total
+    assert acc_q >= acc_f - 0.01, (acc_f, acc_q)   # <=1 point drop
+    assert acc_q > 0.95
+
+
+def test_quantized_graph_logit_parity():
+    from deeplearning4j_tpu.models.transfer_learning import mln_to_graph
+    from deeplearning4j_tpu.zoo import load_pretrained
+
+    graph = mln_to_graph(load_pretrained("LeNet", "mnist"))
+    qi = QuantizedInference(graph)
+    x = np.random.default_rng(1).normal(
+        size=(8, 28, 28, 1)).astype(np.float32)
+    ref = np.asarray(graph.output(x), np.float32)
+    got = np.asarray(qi.output(x), np.float32)
+    # bf16 math + int8 weights: logits close enough that argmax holds
+    np.testing.assert_array_equal(np.argmax(got, -1),
+                                  np.argmax(ref, -1))
+    assert float(np.abs(got - ref).max()) < 0.15
+
+
+def test_quantized_multi_input_graph():
+    from deeplearning4j_tpu import ComputationGraph, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                        OutputLayer)
+    g = (NeuralNetConfiguration.builder().seed(2).graph()
+         .add_inputs("a", "b")
+         .set_input_types(InputType.feed_forward(4),
+                          InputType.feed_forward(6)))
+    g.add_layer("da", DenseLayer(n_out=8, activation="relu"), "a")
+    g.add_layer("db", DenseLayer(n_out=8, activation="relu"), "b")
+    g.add_vertex("m", MergeVertex(), "da", "db")
+    g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"), "m")
+    model = ComputationGraph(g.set_outputs("out").build()).init()
+    qi = QuantizedInference(model)
+    rng = np.random.default_rng(3)
+    xa = rng.normal(size=(5, 4)).astype(np.float32)
+    xb = rng.normal(size=(5, 6)).astype(np.float32)
+    ref = np.asarray(model.output(xa, xb), np.float32)
+    got = np.asarray(qi.output([xa, xb]), np.float32)
+    np.testing.assert_array_equal(np.argmax(got, -1),
+                                  np.argmax(ref, -1))
