@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.nn.conf.layers_recurrent import RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.layers_core import OutputLayer
 from deeplearning4j_tpu.nn.conf.layers_transformer import (
     EmbeddingSequenceLayer, TransformerEncoderBlock, _layer_norm)
 
@@ -162,8 +162,11 @@ class TransformerGenerator:
         self.emb = layers[0]
         self.blocks = layers[1:-1]
         self.head = layers[-1]
-        if not isinstance(self.head, RnnOutputLayer):
-            raise ValueError("generator expects an RnnOutputLayer head")
+        if not isinstance(self.head, OutputLayer):
+            # RnnOutputLayer subclasses OutputLayer: any W/b softmax
+            # head over the final hidden state decodes
+            raise ValueError("generator expects an (Rnn)OutputLayer "
+                             f"head, got {type(self.head).__name__}")
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype else jnp.float32)
         self._fn_cache = {}
