@@ -649,12 +649,18 @@ _FLASH_MIN_T = 512
 
 
 def _auto_blocks(t: int, causal: bool = False):
-    """Measured-best blocks (FLASH_SWEEP_r05 causal_t2048_block_sweep,
-    differential scan protocol at t=2048/d=128 fwd+bwd): q-block 1024,
-    k-block 512 = 2.389 ms — best of the 3x3 grid (next: (1024,1024)
-    2.82, (512,1024) 3.01, (512,512) 3.11, worst (256,256) 5.94).
-    Falls back to the largest tiling block (single-step kernel when one
-    K/V block covers the row)."""
+    """Measured blocks (FLASH_SWEEP_r05 causal_t2048_block_sweep +
+    repeated differential trials at t=2048/d=128 fwd+bwd): the top
+    three configs — (1024,512), (512,1024), (512,512) — measure
+    2.4-3.3 ms and swap ranks BETWEEN runs of the same executable
+    (chip-clock variance exceeds their separation; the artifact's two
+    committed sweeps disagree on the winner for exactly this reason).
+    (1024,512) has the best observed times (2.39-2.53 ms in its good
+    runs) and is the default at flash-routed lengths; 256-sized blocks
+    are reliably 1.3-2.5x worse and are never PICKED here for t
+    divisible by 512 (shorter t falls back to a single t-sized block —
+    attention() routes those to XLA anyway).  Single-step kernel when
+    one K/V block covers the row."""
     bq = 1024 if t % 1024 == 0 else (512 if t % 512 == 0 else t)
     bk = 512 if t % 512 == 0 else t
     return min(bq, t), min(bk, t)

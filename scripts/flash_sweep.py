@@ -172,7 +172,33 @@ def main():
                 blocks.append({"blk_q": bq, "blk_k": bk,
                                "error": str(e)[:120]})
 
+    # bthd layout at the flagship shape: the kernels read [b, t, h, d]
+    # in place (production path for d=128 models) — vs the transposed
+    # bhtd call.  Same data as the block sweep, re-viewed (buffers are
+    # [4, b, h, t, d]; one device-side transpose).
+    bufs4 = tuple(x.swapaxes(2, 3) for x in bufs)
+    blocks_flag = fa._auto_blocks(t, causal=True)
+    bthd_rows = []
+    for lay in ("bthd", "bhtd"):
+        def f(q, k, v, _l=lay, _bl=blocks_flag):
+            if _l == "bhtd":
+                q, k, v = (x.swapaxes(1, 2) for x in (q, k, v))
+            return jnp.sum(fa.flash_attention(
+                q, k, v, *_bl, causal=True,
+                layout=_l).astype(jnp.float32))
+        try:
+            ms = measure(grad_of(f), bufs4)
+            bthd_rows.append({"layout": lay, "blocks": list(blocks_flag),
+                              "note": ("in-place [b,t,h,d]" if
+                                       lay == "bthd" else
+                                       "transpose + flat kernel"),
+                              "ms": round(ms, 3)})
+            print(json.dumps(bthd_rows[-1]), flush=True)
+        except Exception as e:
+            bthd_rows.append({"layout": lay, "error": str(e)[:120]})
+
     out = {"rows": rows, "causal_t2048_block_sweep": blocks,
+           "bthd_flagship_causal_fwd_bwd": bthd_rows,
            "protocol": "fwd+bwd sum(dq)+sum(dk)+sum(dv) grad-of-sum "
                        "(argnums 0,1,2 — symmetric work for flash's "
                        "custom_vjp vs XLA autodiff) inside one jitted "
