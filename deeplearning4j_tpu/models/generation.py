@@ -13,7 +13,11 @@ retrace.
 
 Works over any MultiLayerNetwork whose stack is
 ``EmbeddingSequenceLayer -> N x TransformerEncoderBlock(causal=True)
--> (Rnn)OutputLayer`` (e.g. ``zoo.Gpt``).
+-> (Rnn)OutputLayer`` (e.g. ``zoo.Gpt``).  IMPORTED graphs (SameDiff
+IR) are NOT decodable here yet: they fine-tune through
+``fused_attention`` but have no cached-step form — a known gap (the
+toy imported GPT is pre-LN, so it cannot be mapped onto the post-LN
+zoo blocks either).
 """
 from __future__ import annotations
 

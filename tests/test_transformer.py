@@ -174,3 +174,26 @@ def test_bert_tensor_parallel_matches_single_device():
     single = run(MeshConfig(data=1, model=1))
     tp = run(MeshConfig(data=2, model=4))
     np.testing.assert_allclose(tp, single, rtol=2e-4)
+
+
+def test_encoder_block_takes_bthd_flash_route():
+    """Perf regression guard: at flash-eligible shapes with head dim
+    128, TransformerEncoderBlock must reach the flash kernel through
+    the transpose-free bthd layout (the route log records the flash
+    pick; the layout itself is proven by the kernel parity tests)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import kernels
+    from deeplearning4j_tpu.nn.conf.layers_transformer import (
+        TransformerEncoderBlock)
+    blk = TransformerEncoderBlock(n_heads=2, d_ff=64, causal=True,
+                                  use_flash=True)
+    blk.infer_shapes((512, 256))          # t=512, d_model=256 -> dh=128
+    import jax
+    params, _ = blk.init(jax.random.key(0))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 512, 256)),
+                    jnp.float32)
+    kernels.reset_route_log()
+    y, _ = blk.apply(params, {}, x, training=False)
+    assert y.shape == (2, 512, 256)
+    assert kernels.route_log() == (("flash", 512, 128),), \
+        kernels.route_log()
