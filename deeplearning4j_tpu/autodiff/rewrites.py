@@ -500,6 +500,10 @@ def optimize_for_tpu(sd: SameDiff,
 
 # Ops that treat the last axis identically at any rank — a fold that
 # changes a tensor from [b*t, n] to [b, t, n] commutes with these.
+# "split" qualifies ONLY when its axis is spelled -1: a positional axis
+# (e.g. 1, resolved against the pre-fold rank-2 matmul output) would
+# slice the t dimension of the folded rank-3 tensor — silently wrong
+# numerics, checked per-node in the consumer walk (ADVICE r5).
 _RANK_POLY = frozenset(("bias_add", "add", "identity", "mul", "split",
                         "gelu", "tanh", "relu"))
 
@@ -602,6 +606,12 @@ def fold_flatten_reshapes(sd: SameDiff) -> int:
                     if cn.op_name == "reshape":
                         continue        # re-normalizes: path closed
                     if cn.op_name not in _RANK_POLY:
+                        ok = False
+                        break
+                    if cn.op_name == "split" and \
+                            int(cn.attrs.get("axis", 0)) != -1:
+                        # only the rank-stable "last axis" spelling
+                        # commutes with the rank change (see _RANK_POLY)
                         ok = False
                         break
                     nxt.extend(cn.outputs)

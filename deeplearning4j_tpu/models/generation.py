@@ -21,15 +21,35 @@ zoo blocks either).
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.nn.conf.layers_core import OutputLayer
 from deeplearning4j_tpu.nn.conf.layers_transformer import (
     EmbeddingSequenceLayer, TransformerEncoderBlock, _layer_norm)
+
+
+# Decode telemetry: tokens are THE serving unit for a causal decoder;
+# steps/s is the per-row tick rate the params-bandwidth roofline bounds
+# (GENERATION_r05.json).  A generate() that retraces (new shape key)
+# shows up as a latency outlier in generation_seconds, not a separate
+# series — check _fn_cache hygiene when the histogram grows a tail.
+_GEN_REQS = telemetry.counter(
+    "generation_requests_total", "generate() calls")
+_GEN_TOKENS = telemetry.counter(
+    "generation_tokens_total", "new tokens emitted (rows x n_new)")
+_GEN_RATE = telemetry.gauge(
+    "generation_decode_steps_per_sec",
+    "decode ticks/sec over the last generate() (per-row token rate)")
+_GEN_TIME = telemetry.histogram(
+    "generation_seconds",
+    "wall time per generate() call incl. prefill, decode scan, host "
+    "sync (first call per shape includes compile)")
 
 
 def _embed_token(ly: EmbeddingSequenceLayer, params, tok, pos):
@@ -225,9 +245,17 @@ class TransformerGenerator:
         emb_p, blk_ps, head_p = self._params()
         ids = jnp.concatenate(
             [prompt_ids, jnp.zeros((b, n_new), jnp.int32)], axis=1)
-        out = self._fn_cache[key](emb_p, blk_ps, head_p, ids,
-                                  jax.random.PRNGKey(seed))
-        return np.asarray(out)
+        t_start = time.perf_counter()
+        with telemetry.span("generate", batch=b, prompt=t0, new=n_new):
+            out = np.asarray(self._fn_cache[key](
+                emb_p, blk_ps, head_p, ids, jax.random.PRNGKey(seed)))
+        dt = time.perf_counter() - t_start
+        _GEN_REQS.inc()
+        _GEN_TOKENS.inc(b * n_new)
+        _GEN_TIME.observe(dt)
+        if dt > 0:
+            _GEN_RATE.set(n_new / dt)
+        return out
 
     def _prefill(self, emb_p, blk_ps, head_p, prompt, L):
         """Batched prompt pass: fill every block's KV cache for

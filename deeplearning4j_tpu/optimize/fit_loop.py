@@ -9,7 +9,30 @@ the step function (plain solver step, or the sharded-mesh step).
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
+
+from deeplearning4j_tpu import telemetry
+
+# Structural fit-loop telemetry — fires for EVERY training entry point
+# (plain fit, ShardedTrainer, tBPTT) without any listener attached.
+# "data wait" vs "step" is the first question a slow run asks: is the
+# chip starved by the input pipeline or is the step itself the cost?
+# Host-side split: step time here is dispatch + any blocking the solver
+# does; time INSIDE the XLA program shows up in whichever of the two
+# the device queue back-pressures into.
+_ITERS = telemetry.counter(
+    "train_iterations_total", "optimizer steps driven by run_fit")
+_EPOCHS = telemetry.counter("train_epochs_total", "completed epochs")
+_EXAMPLES = telemetry.counter(
+    "train_examples_total", "examples consumed from the iterator")
+_DATA_WAIT = telemetry.histogram(
+    "train_data_wait_seconds",
+    "host wall time blocked on the data iterator per batch")
+_STEP_TIME = telemetry.histogram(
+    "train_step_dispatch_seconds",
+    "host wall time in step_fn per tBPTT chunk (dispatch + listener "
+    "sync, not device completion)")
 
 
 def run_fit(model, iterator, n_epochs: int,
@@ -35,14 +58,26 @@ def run_fit(model, iterator, n_epochs: int,
                  if getattr(model.conf, "backprop_type", "standard")
                  == "truncated_bptt" else 0)
     last_loss = None
+    tracer = telemetry.get_tracer()
     for _ in range(n_epochs):
         for lst in model.listeners:
             lst.on_epoch_start(model, model.epoch_count)
-        for ds in iterator:
+        data_it = iter(iterator)
+        while True:
+            t_fetch = time.perf_counter()
+            try:
+                ds = next(data_it)
+            except StopIteration:
+                break
+            _DATA_WAIT.observe(time.perf_counter() - t_fetch)
             model.last_batch_size = ds.num_examples()
+            _EXAMPLES.inc(model.last_batch_size)
             chunks = tbptt_segments(ds, tbptt_len) if tbptt_len else [ds]
             for chunk in chunks:
-                loss = step_fn(model._batch_dict(chunk))
+                t_step = time.perf_counter()
+                with tracer.span("train/step",
+                                 iteration=model.iteration_count):
+                    loss = step_fn(model._batch_dict(chunk))
                 last_loss = loss
                 # Listeners fire BEFORE the counter increments, so a
                 # checkpoint taken in iteration_done records the step it
@@ -50,6 +85,8 @@ def run_fit(model, iterator, n_epochs: int,
                 for lst in model.listeners:
                     lst.iteration_done(model, model.iteration_count,
                                        model.epoch_count, loss)
+                _STEP_TIME.observe(time.perf_counter() - t_step)
+                _ITERS.inc()
                 model.iteration_count += 1
             # Recurrent carry flows ACROSS tBPTT chunks of one batch (that
             # is the point of truncated BPTT) but never across batches.
@@ -58,6 +95,7 @@ def run_fit(model, iterator, n_epochs: int,
         # Increment BEFORE epoch listeners so a checkpoint taken in
         # on_epoch_end records "N epochs completed" and resumes exactly.
         model.epoch_count += 1
+        _EPOCHS.inc()
         for lst in model.listeners:
             lst.on_epoch_end(model, model.epoch_count - 1)
         (reset_target if reset_target is not None else iterator).reset()

@@ -27,6 +27,18 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import telemetry
+
+# One series answers "how much of the schedule is bubble" whichever
+# driver built it — ShardedTrainer's pipelined path imports this
+# family rather than redefining it.
+_PIPE_BUBBLE = telemetry.gauge(
+    "pipeline_bubble_fraction",
+    "(S-1)/(S-1+n_micro) idle fraction of the GPipe schedule")
+_PIPE_STEPS = telemetry.counter(
+    "pipeline_steps_total", "PipelinedTransformerLM optimizer steps",
+    labelnames=("worker",))
+
 
 def stack_block_params(block_conf, n_blocks: int, key,
                        dtype=jnp.float32):
@@ -213,6 +225,10 @@ class PipelinedTransformerLM:
         self._forward = jax.jit(forward)
         self._step = jax.jit(step)
         self._it = 0
+        _PIPE_BUBBLE.set((mesh.shape[p_axis] - 1)
+                         / (mesh.shape[p_axis] - 1 + n_micro))
+        self._step_counter = _PIPE_STEPS.labels(
+            worker=jax.process_index())
 
     def _shard_in(self, a):
         a = jnp.asarray(a)
@@ -222,11 +238,14 @@ class PipelinedTransformerLM:
             self.mesh, P(*([self._data_axis] + [None] * (a.ndim - 1)))))
 
     def fit_batch(self, ids, labels):
-        self.params, self.opt_state, loss = self._step(
-            self.params, self.opt_state, self._shard_in(ids),
-            self._shard_in(labels), self._it)
+        with telemetry.span("train/pipeline_step", iteration=self._it):
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, self._shard_in(ids),
+                self._shard_in(labels), self._it)
+            loss = float(loss)
         self._it += 1
-        return float(loss)
+        self._step_counter.inc()
+        return loss
 
     def predict(self, ids):
         return np.asarray(self._forward(self.params,

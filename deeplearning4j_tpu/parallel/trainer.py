@@ -23,10 +23,25 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.optimize.fit_loop import run_fit
 from deeplearning4j_tpu.parallel.mesh import MeshConfig
 
 log = logging.getLogger("deeplearning4j_tpu")
+
+# Per-worker step counters: each jax.distributed process runs its own
+# registry, labeled by process index; the driver folds worker snapshots
+# with MetricsRegistry.merge_snapshot() (counters add across workers,
+# so the merged sharded_steps_total{worker=...} series enumerate the
+# fleet).  Collectives inside the jitted step are NOT host-visible —
+# the dispatch span bounds them; per-op device time needs XProf.
+# The bubble gauge family lives in pipeline.py (one definition, both
+# GPipe drivers set it).
+from deeplearning4j_tpu.parallel.pipeline import _PIPE_BUBBLE
+
+_STEPS = telemetry.counter(
+    "sharded_steps_total", "compiled mesh steps dispatched",
+    labelnames=("worker",))
 
 
 def _tp_shardable_layers(model) -> dict:
@@ -129,6 +144,7 @@ class ShardedTrainer:
         self.mesh = self.mesh_conf.build(devices)
         self.tp = self.mesh_conf.model
         self.n_micro = n_micro
+        self._step_counter = _STEPS.labels(worker=jax.process_index())
         model._check_init()
         if self.mesh_conf.pipeline > 1:
             self._init_pipelined()
@@ -189,6 +205,7 @@ class ShardedTrainer:
             log.warning("pipelined blocks run without dropout "
                         "(configured rate %.3g)", drop)
         self._pipe = (lo, hi)
+        _PIPE_BUBBLE.set((S - 1) / (S - 1 + self.n_micro))
         blocks = [model.params_tree[f"layer_{i}"] for i in range(lo, hi)]
         stacked = jax.tree_util.tree_map(
             lambda *ls: jnp.stack(ls), *blocks)
@@ -349,8 +366,10 @@ class ShardedTrainer:
 
     def _step_dict(self, batch: dict):
         """Run the compiled sharded step on a prepared batch dict WITHOUT
-        touching counters."""
+        touching the model's iteration counters (telemetry step counters
+        DO advance — they count dispatches, not fit-loop iterations)."""
         m = self.model
+        tracer = telemetry.get_tracer()
         if self._pipe is not None:
             if "features_mask" in batch or "labels_mask" in batch:
                 raise ValueError("pipeline path does not support "
@@ -358,16 +377,20 @@ class ShardedTrainer:
             batch = self._shard_batch(
                 {"features": batch["features"],
                  "labels": batch["labels"]})
-            with self.mesh:
+            with tracer.span("train/pipeline_step",
+                             mesh=str(dict(self.mesh.shape))), self.mesh:
                 (self._pipe_params, self._pipe_opt, loss) = \
                     self._pipe_step(self._pipe_params, self._pipe_opt,
                                     m.iteration_count, batch)
+            self._step_counter.inc()   # dispatched, not failed validation
             return loss
         batch = self._shard_batch(batch)
-        with self.mesh:
+        with tracer.span("train/sharded_step",
+                         mesh=str(dict(self.mesh.shape))), self.mesh:
             (m.params_tree, m.opt_state, m.state_tree, loss) = \
                 self.solver.step(m.params_tree, m.opt_state, m.state_tree,
                                  m.iteration_count, batch, m._rng.next_key())
+        self._step_counter.inc()
         return loss
 
     def _step_batch(self, features, labels, features_mask=None,

@@ -1,0 +1,81 @@
+"""Stdlib Prometheus scrape endpoint — ``/metrics`` over ``http.server``.
+
+No web framework exists in this image (and the ROADMAP's "no live UI
+server" stance stands for dashboards); a scrape endpoint is different —
+it is how a fleet's Prometheus/VictoriaMetrics reaches a training or
+serving process, and ``ThreadingHTTPServer`` from the stdlib is enough:
+a scrape is one GET returning one rendered string.
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Background scrape server; ``port=0`` binds an ephemeral port
+    (read it back from ``.port`` — what tests and the smoke script use).
+
+    >>> srv = MetricsServer(registry, port=9464).start()
+    >>> # curl localhost:9464/metrics
+    >>> srv.close()
+    """
+
+    def __init__(self, registry: MetricsRegistry, port: int = 9464,
+                 host: str = "127.0.0.1"):
+        self.registry = registry
+        self._httpd = ThreadingHTTPServer((host, port), self._handler())
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _handler(self):
+        registry = self.registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = registry.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # keep scrapes out of stderr
+                pass
+
+        return Handler
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="dl4j-tpu-metrics",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "MetricsServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def start_metrics_server(registry: MetricsRegistry, port: int = 9464,
+                         host: str = "127.0.0.1") -> MetricsServer:
+    """One-liner: start a daemon scrape endpoint for ``registry``."""
+    return MetricsServer(registry, port=port, host=host).start()

@@ -1,0 +1,94 @@
+"""Lightweight nestable span tracer with Chrome-trace jsonl export.
+
+Spans are host-side wall-time intervals (``with tracer.span("train/step")``)
+recorded as Chrome Trace Event Format complete events (``"ph": "X"``) —
+the schema ``about://tracing`` / Perfetto / ``chrome://tracing`` load
+directly.  Nesting needs no explicit parent pointers: the viewers nest
+same-thread events by timestamp containment, which a ``with``-stack
+guarantees.  For per-op DEVICE timelines use ``ui.ProfilerListener``
+(XProf); this tracer answers the host-side question XProf doesn't —
+where Python time goes between program launches (data wait, dispatch,
+queue drain, serve batching).
+
+Thread-safe: the event buffer is a bounded ``deque`` (appends are
+atomic), each span carries the recording thread's id, and a long-lived
+serving process can't grow the buffer without end.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+
+class SpanTracer:
+    """Record nested timed spans; export them for trace viewers.
+
+    >>> tracer = SpanTracer()
+    >>> with tracer.span("serve/batch", size=4):
+    ...     with tracer.span("serve/forward"):
+    ...         pass
+    >>> tracer.export_jsonl("trace.jsonl")
+    """
+
+    def __init__(self, max_events: int = 65536, enabled: bool = True):
+        self.enabled = enabled
+        self._events: collections.deque = collections.deque(
+            maxlen=max_events)
+        self._t0 = time.perf_counter_ns()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0) / 1e3
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args) -> Iterator[None]:
+        """Time a block; records one complete ("X") event on exit.
+        Exceptions propagate; the span still records with an
+        ``"error"`` arg so a trace shows where a request died."""
+        if not self.enabled:
+            yield
+            return
+        start = self._now_us()
+        try:
+            yield
+        except BaseException as e:
+            args = dict(args, error=type(e).__name__)
+            raise
+        finally:
+            self._events.append({
+                "name": name, "ph": "X", "ts": start,
+                "dur": self._now_us() - start,
+                "pid": os.getpid(), "tid": threading.get_ident(),
+                "args": args,
+            })
+
+    def events(self) -> List[Dict]:
+        return list(self._events)
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    def export_jsonl(self, path: str) -> str:
+        """One Chrome trace event per line.  Perfetto/catapult accept
+        newline-delimited event objects; ``export_chrome_trace`` writes
+        the strict ``{"traceEvents": [...]}`` envelope instead."""
+        d = os.path.dirname(str(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            for ev in self.events():
+                f.write(json.dumps(ev) + "\n")
+        return str(path)
+
+    def export_chrome_trace(self, path: str) -> str:
+        d = os.path.dirname(str(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events(),
+                       "displayTimeUnit": "ms"}, f)
+        return str(path)

@@ -10,11 +10,17 @@ Reference surfaces replaced (SURVEY §5.1/§5.5):
   (host-side scan of loss/grads/params with named-leaf errors).
 * profiling → ``ProfilerListener`` driving ``jax.profiler`` traces
   (XProf/TensorBoard-compatible).
+* fleet metrics/tracing live in ``deeplearning4j_tpu.telemetry``
+  (registry + Prometheus scrape + span tracer); ``TelemetryListener``
+  is re-exported here so ``set_listeners`` users find it next to
+  ``StatsListener``, and ``render_report`` tabulates its snapshots.
 """
 from deeplearning4j_tpu.ui.stats import (
     FileStatsStorage, InMemoryStatsStorage, ProfilerListener, StatsListener,
     StatsStorage)
 from deeplearning4j_tpu.ui.report import render_report
+from deeplearning4j_tpu.telemetry import TelemetryListener
 
 __all__ = ["StatsListener", "StatsStorage", "InMemoryStatsStorage",
-           "FileStatsStorage", "ProfilerListener", "render_report"]
+           "FileStatsStorage", "ProfilerListener", "TelemetryListener",
+           "render_report"]

@@ -97,30 +97,79 @@ def _line_chart(xs: List[float], ys: List[float], title: str,
         f"{labels}</svg>")
 
 
+def _fmt_val(v) -> str:
+    try:
+        return f"{float(v):.6g}"
+    except (TypeError, ValueError):
+        return html.escape(str(v))
+
+
+def _telemetry_section(snap: dict) -> str:
+    """Tables from one ``telemetry_snapshot`` record (the jsonl form a
+    ``TelemetryListener(storage=...)`` appends per epoch): scalar series,
+    then histograms with their bucket-derived p50/p95/p99."""
+    scalars = {**snap.get("counters", {}), **snap.get("gauges", {})}
+    rows = "".join(
+        f"<tr><td style=text-align:left>{html.escape(k)}</td>"
+        f"<td>{_fmt_val(v)}</td></tr>"
+        for k, v in sorted(scalars.items()))
+    hrows = "".join(
+        f"<tr><td style=text-align:left>{html.escape(k)}</td>"
+        f"<td>{h.get('count', 0)}</td><td>{_fmt_val(h.get('sum', 0))}</td>"
+        f"<td>{_fmt_val(h.get('p50'))}</td><td>{_fmt_val(h.get('p95'))}</td>"
+        f"<td>{_fmt_val(h.get('p99'))}</td></tr>"
+        for k, h in sorted(snap.get("histograms", {}).items()))
+    out = "<h2>Telemetry</h2>"
+    if rows:
+        out += ("<table><tr><th>series</th><th>value</th></tr>"
+                + rows + "</table>")
+    if hrows:
+        out += ("<table><tr><th>histogram</th><th>count</th><th>sum</th>"
+                "<th>p50</th><th>p95</th><th>p99</th></tr>"
+                + hrows + "</table>")
+    return out
+
+
 def render_report(storage: StatsStorage, path: str,
-                  title: str = "Training report") -> Optional[str]:
-    """Write the HTML report; returns the path (None if no records)."""
+                  title: str = "Training report",
+                  trace_path: Optional[str] = None) -> Optional[str]:
+    """Write the HTML report; returns the path (None if no records).
+
+    The storage may interleave per-iteration stats records with
+    ``telemetry_snapshot`` records (``TelemetryListener(storage=...)``);
+    the latest snapshot renders as a metrics table.  ``trace_path``
+    links an exported span trace (``SpanTracer.export_jsonl``) for
+    ``about://tracing``-style viewers."""
     recs = storage.records()
     if not recs:
         return None
-    its = [r["iteration"] for r in recs]
-    losses = [r["loss"] for r in recs]
-    thr = [(r["iteration"], r["examples_per_sec"]) for r in recs
+    iter_recs = [r for r in recs if "iteration" in r and "loss" in r]
+    snaps = [r for r in recs if r.get("type") == "telemetry_snapshot"]
+    its = [r["iteration"] for r in iter_recs]
+    losses = [r["loss"] for r in iter_recs]
+    thr = [(r["iteration"], r["examples_per_sec"]) for r in iter_recs
            if "examples_per_sec" in r]
     rows = "".join(
         f"<tr><td>{r['iteration']}</td><td>{r['epoch']}</td>"
         f"<td>{r['loss']:.6g}</td>"
-        f"<td>{r.get('examples_per_sec', '')}</td></tr>" for r in recs)
+        f"<td>{r.get('examples_per_sec', '')}</td></tr>"
+        for r in iter_recs)
+    meta = (f"{len(iter_recs)} iterations · final loss {losses[-1]:.6g}"
+            if iter_recs else "no iteration records")
+    if trace_path:
+        meta += (' · <a href="' + html.escape(str(trace_path), quote=True)
+                 + '">span trace (load in about://tracing / Perfetto)'
+                   '</a>')
     body = (
         f"<h1>{html.escape(title)}</h1>"
-        f"<p class=meta>{len(recs)} iterations · final loss "
-        f"{losses[-1]:.6g}</p>"
-        + _line_chart(its, losses, "Loss", "loss")
+        f"<p class=meta>{meta}</p>"
+        + (_line_chart(its, losses, "Loss", "loss") if iter_recs else "")
         + (_line_chart([t[0] for t in thr], [t[1] for t in thr],
                        "Throughput", "ex/s") if thr else "")
-        + "<details><summary>Data table</summary><table>"
-          "<tr><th>iter</th><th>epoch</th><th>loss</th><th>ex/s</th></tr>"
-        + rows + "</table></details>"
+        + (_telemetry_section(snaps[-1]) if snaps else "")
+        + ("<details><summary>Data table</summary><table>"
+           "<tr><th>iter</th><th>epoch</th><th>loss</th><th>ex/s</th></tr>"
+           + rows + "</table></details>" if iter_recs else "")
         + '<div id="tip" class="tip"></div>')
     doc = (f"<!doctype html><meta charset=utf-8><title>{html.escape(title)}"
            f"</title><style>{_CSS}</style>"

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Telemetry smoke check — the combined train+serve run the acceptance
+bar asks for: 5 training iterations + 16 concurrent serve requests with
+the Prometheus scrape endpoint live, then assert the scrape is healthy.
+
+Fails (exit 1) when:
+* fewer than 20 distinct series are exposed,
+* any histogram sum is NaN,
+* a required series is missing (``inference_latency_seconds`` buckets,
+  ``flash_route_total{path=...}``, the ``mfu`` gauge, the fit loop's
+  data-wait/step split), or
+* the exported span trace or the report embedding is empty.
+
+Runs on CPU inside the tier-1 budget (tiny MLP, seconds) — wired into
+``tests/test_telemetry.py::test_check_telemetry_smoke`` un-marked (i.e.
+``not slow`` selects it), and runnable standalone:
+
+    JAX_PLATFORMS=cpu python scripts/check_telemetry.py
+"""
+import json
+import math
+import os
+import sys
+import tempfile
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration, telemetry)
+    from deeplearning4j_tpu import kernels
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                        OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    from deeplearning4j_tpu.parallel import ParallelInference
+    from deeplearning4j_tpu.ui import InMemoryStatsStorage, render_report
+
+    import jax.numpy as jnp
+
+    registry = telemetry.get_registry()
+    tracer = telemetry.get_tracer()
+    problems = []
+
+    # -- train: 5 iterations with the telemetry listener ---------------
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=1e-2)).list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    storage = InMemoryStatsStorage()
+    # ~2*params*3 train FLOPs/example for the 8-16-4 MLP — real enough
+    # for the mfu gauge to be a number, which is all a smoke asserts
+    flops = 2 * 3 * (8 * 16 + 16 * 4)
+    model.set_listeners(telemetry.TelemetryListener(
+        storage=storage, flops_per_example=flops, peak_flops=1e12))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(5 * 32, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, len(x))]
+    model.fit(ListDataSetIterator(DataSet(x, y).batch_by(32)), n_epochs=1)
+
+    # -- touch the kernel router so flash_route_total has a child ------
+    q = jnp.asarray(rng.normal(size=(1, 2, 8, 4)), jnp.float32)
+    kernels.attention(q, q, q)
+
+    # -- serve: 16 concurrent requests ---------------------------------
+    # the registry is process-global (tests may have served already):
+    # assert the DELTA this run contributes
+    lat = registry.histogram("inference_latency_seconds")
+    lat_before = lat.count
+    xs = [rng.normal(size=(8,)).astype(np.float32) for _ in range(16)]
+    with ParallelInference(model, batch_limit=8, timeout_ms=5) as pi:
+        errs = []
+
+        def call(i):
+            try:
+                pi.output(xs[i])
+            except Exception as e:  # pragma: no cover - smoke surface
+                errs.append(f"request {i}: {e}")
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        problems += errs
+
+    # -- scrape over HTTP ----------------------------------------------
+    with telemetry.start_metrics_server(registry, port=0) as srv:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ).read().decode()
+
+    series = {line.rsplit(" ", 1)[0] for line in body.splitlines()
+              if line and not line.startswith("#")}
+    if len(series) < 20:
+        problems.append(f"only {len(series)} series exposed (< 20)")
+    for fam in registry.families():
+        if fam.kind != "histogram":
+            continue
+        for lv, child in fam._items():
+            s = child.state()[2]
+            if math.isnan(s):
+                problems.append(f"histogram {fam.name}{lv} sum is NaN")
+    required = [
+        'inference_latency_seconds_bucket',
+        'flash_route_total{path="xla"}',
+        "mfu ",
+        "train_data_wait_seconds_bucket",
+        "train_step_dispatch_seconds_bucket",
+    ]
+    for needle in required:
+        if needle not in body:
+            problems.append(f"required series missing: {needle!r}")
+    if lat.count - lat_before != 16:
+        problems.append(
+            f"latency histogram grew {lat.count - lat_before} != 16")
+
+    # -- trace export + report embedding -------------------------------
+    with tempfile.TemporaryDirectory() as d:
+        trace = tracer.export_jsonl(os.path.join(d, "trace.jsonl"))
+        if os.path.getsize(trace) == 0:
+            problems.append("span trace export is empty")
+        out = render_report(storage, os.path.join(d, "report.html"),
+                            trace_path="trace.jsonl")
+        html = open(out).read() if out else ""
+        if "Telemetry" not in html or "trace.jsonl" not in html:
+            problems.append("report missing telemetry table or trace link")
+
+    print(json.dumps({"ok": not problems, "series": len(series),
+                      "problems": problems}))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

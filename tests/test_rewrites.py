@@ -349,3 +349,40 @@ def test_folded_matmul_expect_k_fallback():
     a2 = jnp.arange(24, dtype=jnp.float32).reshape(2, 2, 6)
     np.testing.assert_allclose(mm(a2, w, expect_k=4),
                                jnp.matmul(a2.reshape(-1, 4), w))
+
+
+def _tensordot_split_ir(split_axis):
+    """Tensordot sandwich whose matmul feeds a split: reshape(x,[6,4])
+    -> matmul(W[4,6]) -> split -> reshape back to rank 3."""
+    from deeplearning4j_tpu.autodiff import SameDiff
+    sd = SameDiff.create()
+    sd.placeholder("x", (2, 3, 4))
+    shp = sd.constant("shp", np.array([6, 4], np.int64))
+    flat = sd.op("reshape", sd.vars["x"], shp, name="flat")
+    rng = np.random.default_rng(0)
+    w = sd.var("W", value=rng.normal(size=(4, 6)).astype(np.float32))
+    mm = sd.op("matmul", flat, w, name="mm")
+    parts = sd.op("split", mm, n_out=2, num_split=2, axis=split_axis,
+                  name="sp")
+    shp2 = sd.constant("shp2", np.array([2, 3, 3], np.int64))
+    outs = [sd.op("reshape", p, shp2, name=f"out{i}")
+            for i, p in enumerate(parts)]
+    return sd, [o.name for o in outs]
+
+
+@pytest.mark.parametrize("axis,expect_folds", [(-1, 1), (1, 0)])
+def test_fold_flatten_reshapes_split_axis_guard(axis, expect_folds):
+    """ADVICE r5: a split with a POSITIONAL axis (resolved against the
+    pre-fold rank-2 matmul output) would slice the t dimension of the
+    folded rank-3 tensor — the fold must fire only for the rank-stable
+    axis == -1 spelling, and numerics must be identical either way."""
+    from deeplearning4j_tpu.autodiff.rewrites import fold_flatten_reshapes
+    x = np.random.default_rng(1).normal(size=(2, 3, 4)).astype(np.float32)
+    sd, outs = _tensordot_split_ir(axis)
+    before = sd.output({"x": x}, outs)
+    folds = fold_flatten_reshapes(sd)
+    assert folds == expect_folds, (axis, folds)
+    after = sd.output({"x": x}, outs)
+    for name in outs:
+        np.testing.assert_allclose(np.asarray(after[name]),
+                                   np.asarray(before[name]), atol=1e-6)
