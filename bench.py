@@ -346,6 +346,87 @@ def bench_gpt():
             "causal_flash_routes": causal_flash}
 
 
+def bench_serving_decode(streams_ladder=(1, 4, 16), n_slots=16,
+                         t0=512, n_new=128):
+    """Continuous-batching serve window (GENERATION-style artifact):
+    aggregate new_tokens_per_sec and TTFT p50/p99 at 1/4/16 concurrent
+    streams through ``GenerationServer``, against the back-to-back
+    single-caller ``generate()`` throughput the server must beat —
+    every decode tick streams all params, so tokens/s should scale
+    nearly free with occupied slots until memory binds."""
+    import threading
+
+    import jax
+    from deeplearning4j_tpu.models.generation import TransformerGenerator
+    from deeplearning4j_tpu.parallel import GenerationServer
+    from deeplearning4j_tpu.zoo.gpt import Gpt
+
+    if jax.default_backend() not in ("tpu",):
+        raise RuntimeError("serving_decode bench requires a TPU backend")
+
+    m = Gpt(seq_len=t0, max_len=t0 + n_new)
+    net = m.init_graph()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, m.vocab_size, t0).astype(np.int32)
+               for _ in range(2 * max(streams_ladder))]
+
+    # single-caller baseline: b=1 offline calls back to back
+    gen = TransformerGenerator(net, compute_dtype="bfloat16")
+    gen.generate(prompts[0][None], n_new=n_new)          # compile
+    t_base = time.perf_counter()
+    for p in prompts[1:4]:
+        gen.generate(p[None], n_new=n_new)
+    base_tok_s = 3 * n_new / (time.perf_counter() - t_base)
+
+    ladder = []
+    with GenerationServer(net, n_slots=n_slots, max_len=t0 + n_new,
+                          compute_dtype="bfloat16") as srv:
+        srv.submit(prompts[0], n_new=8)                  # compile path
+        for streams in streams_ladder:
+            reqs = prompts[:2 * streams]
+            handles = [None] * len(reqs)
+            errs = []
+
+            def caller(lo):
+                try:
+                    for i in range(lo, len(reqs), streams):
+                        handles[i] = srv.submit_async(reqs[i],
+                                                      n_new=n_new)
+                        handles[i].result()
+                except Exception as e:   # threads swallow otherwise
+                    errs.append(e)
+
+            t_w = time.perf_counter()
+            threads = [threading.Thread(target=caller, args=(s,))
+                       for s in range(streams)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errs:
+                raise errs[0]
+            dt = time.perf_counter() - t_w
+            ttfts = sorted(h.ttft for h in handles)
+            ladder.append({
+                "streams": streams,
+                "requests": len(reqs),
+                "new_tokens_per_sec": round(len(reqs) * n_new / dt, 1),
+                "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 4),
+                "ttft_p99_s": round(float(np.percentile(ttfts, 99)), 4),
+            })
+    agg16 = ladder[-1]["new_tokens_per_sec"]
+    return {"metric": "serving_decode_continuous_batching",
+            "value": agg16, "unit": "new_tokens/sec",
+            "model": "zoo.Gpt GPT-2-small-shaped",
+            "n_slots": n_slots, "prompt_len": t0, "n_new": n_new,
+            "single_caller_tokens_per_sec": round(base_tok_s, 1),
+            "vs_baseline": round(agg16 / base_tok_s, 3),
+            "ladder": ladder,
+            "note": "vs_baseline is aggregate server tokens/s at the "
+                    "top of the ladder over back-to-back offline "
+                    "generate(); acceptance bar is >= 2x"}
+
+
 def bench_mnist_mlp():
     import jax
     import jax.numpy as jnp
@@ -398,7 +479,8 @@ def main():
     except Exception:
         result = bench_mnist_mlp()
     result["secondary"] = []
-    for fn in (bench_bert, bench_bert_imported, bench_gpt):
+    for fn in (bench_bert, bench_bert_imported, bench_gpt,
+               bench_serving_decode):
         try:
             result["secondary"].append(fn())
         except Exception as e:  # secondaries must never sink the primary

@@ -4,12 +4,21 @@ recurrent state between calls; here the state is each block's key/value
 cache).
 
 TPU-first design: generation is ONE jitted ``lax.scan`` over time with
-static shapes — the KV caches are preallocated [b, h, max_len, dh]
-buffers written via ``lax.dynamic_update_slice``, the prompt prefills
-in ONE batched causal forward (matmul-rate, not the per-step
-params-bandwidth floor), and sampling scans one token per tick — the
-whole decode is a single XLA program, no per-token Python dispatch or
-retrace.
+static shapes — the KV caches are preallocated [n_layers, b, h,
+max_len, dh] buffers written via ``lax.dynamic_update_slice``, the
+prompt prefills in ONE batched causal forward (matmul-rate, not the
+per-step params-bandwidth floor), and sampling scans one token per
+tick — the whole decode is a single XLA program, no per-token Python
+dispatch or retrace.  The homogeneous block params are stacked on a
+leading [n_layers] axis and BOTH the prefill and the decode tick
+``lax.scan`` over layers, so the program size is O(1) in depth instead
+of inlining n_layers copies of the block body.
+
+Concurrent serving over this machinery (many callers multiplexed onto
+one decode tick, Orca-style continuous batching) lives in
+``parallel/generation_server.py`` — ``_embed_token``/
+``_block_decode_step`` accept per-row position VECTORS for exactly
+that caller.
 
 Works over any MultiLayerNetwork whose stack is
 ``EmbeddingSequenceLayer -> N x TransformerEncoderBlock(causal=True)
@@ -53,11 +62,16 @@ _GEN_TIME = telemetry.histogram(
 
 
 def _embed_token(ly: EmbeddingSequenceLayer, params, tok, pos):
-    """[b] int token at scalar position -> [b, d]."""
+    """[b] int token -> [b, d].  ``pos`` is a scalar (one shared
+    position, the offline decode scan) or a [b] int32 vector (per-row
+    positions, the continuous-batching server's slots)."""
     y = jnp.take(params["W"], tok.astype(jnp.int32), axis=0)
     if ly.add_positional:
-        y = y + jax.lax.dynamic_slice_in_dim(
-            params["P"], pos, 1, axis=0)[0]
+        if jnp.ndim(pos) == 0:
+            y = y + jax.lax.dynamic_slice_in_dim(
+                params["P"], pos, 1, axis=0)[0]
+        else:
+            y = y + jnp.take(params["P"], pos, axis=0)
     if ly.layer_norm:
         y = _layer_norm(y, params["g"], params["b"], ly.eps)
     return y
@@ -67,6 +81,9 @@ def _block_decode_step(ly: TransformerEncoderBlock, params, kcache,
                        vcache, x, pos):
     """One cached decoder step.  x: [b, d] new-token hidden; caches
     [b, h, L, dh]; writes position ``pos``, attends over <= pos.
+    ``pos`` may be a scalar (whole batch at one position) or a [b]
+    vector (per-row positions — slots in the generation server decode
+    at independent depths inside ONE static-shape program).
     Returns (y [b, d], kcache, vcache)."""
     b, d = x.shape
     h, dh = ly.n_heads, d // ly.n_heads
@@ -77,14 +94,22 @@ def _block_decode_step(ly: TransformerEncoderBlock, params, kcache,
     q, k, v = jnp.split(qkv, 3, axis=-1)
     split = lambda z: z.reshape(b, h, 1, dh)
     q, k, v = split(q), split(k), split(v)
-    kcache = jax.lax.dynamic_update_slice(kcache, k, (0, 0, pos, 0))
-    vcache = jax.lax.dynamic_update_slice(vcache, v, (0, 0, pos, 0))
+    if jnp.ndim(pos) == 0:
+        kcache = jax.lax.dynamic_update_slice(kcache, k, (0, 0, pos, 0))
+        vcache = jax.lax.dynamic_update_slice(vcache, v, (0, 0, pos, 0))
+        valid = (jnp.arange(L) <= pos)[None, None, None, :]
+    else:
+        write = jax.vmap(lambda c, n, p: jax.lax.dynamic_update_slice(
+            c, n, (0, p, 0)))
+        kcache = write(kcache, k, pos)
+        vcache = write(vcache, v, pos)
+        valid = (jnp.arange(L)[None, :]
+                 <= pos[:, None])[:, None, None, :]
 
     scale = 1.0 / (dh ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, kcache).astype(jnp.float32)
     s = s * scale
-    valid = jnp.arange(L) <= pos                      # causal: <= pos
-    s = jnp.where(valid[None, None, None, :], s, -1e9)
+    s = jnp.where(valid, s, -1e9)
     p = jax.nn.softmax(s, axis=-1).astype(vcache.dtype)
     att = jnp.einsum("bhqk,bhkd->bhqd", p, vcache)
     att = att.transpose(0, 2, 1, 3).reshape(b, d)
@@ -182,6 +207,17 @@ class TransformerGenerator:
         for l in layers[1:-1]:
             if not l.causal:
                 raise ValueError("generation requires causal=True blocks")
+        import dataclasses
+        ref = dataclasses.asdict(layers[1])
+        if any(dataclasses.asdict(l) != ref for l in layers[2:-1]):
+            # the decode tick stacks the block params on a leading axis
+            # and lax.scans over layers (ONE traced block body instead
+            # of n_layers inlined copies) — that stack needs
+            # conf-identical blocks.  Every in-tree decoder (zoo.Gpt)
+            # is homogeneous.
+            raise ValueError("generator requires conf-identical "
+                             "TransformerEncoderBlocks (the decode "
+                             "tick scans stacked block params)")
         self.net = net
         self.emb = layers[0]
         self.blocks = layers[1:-1]
@@ -196,21 +232,41 @@ class TransformerGenerator:
         self._fn_cache = {}
 
     def _params(self):
+        self.net._check_init()   # fires any lazy _param_sync_hook
         pt = self.net.params_tree
         n = len(self.net.layers)
         return (pt["layer_0"],
                 [pt[f"layer_{i}"] for i in range(1, n - 1)],
                 pt[f"layer_{n - 1}"])
 
-    def _step(self, emb_p, blk_ps, head_p, caches, tok, pos):
+    @staticmethod
+    def _stack_blocks(blk_ps):
+        """List of per-block param dicts -> one dict with a leading
+        [n_layers] axis on every leaf — the layout ``_step``'s
+        layer-scan consumes.  Inside jit the stack is a compile-time
+        concatenate; the scan body then references ONE block's worth of
+        program, so the decode tick's XLA program size stays O(1) in
+        depth instead of inlining n_layers copies."""
+        return jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *blk_ps)
+
+    def _step(self, emb_p, blk_stack, head_p, kc, vc, tok, pos):
+        """One decode tick.  ``blk_stack`` is ``_stack_blocks`` output;
+        ``kc``/``vc`` are [n_layers, b, h, L, dh]; ``pos`` is a scalar
+        (offline scan) or [b] vector (server slots).  Returns
+        (logits [b, V], kc, vc)."""
         x = _embed_token(self.emb, emb_p, tok, pos)
         x = x.astype(self.compute_dtype)
-        new_caches = []
-        for ly, p, (kc, vc) in zip(self.blocks, blk_ps, caches):
-            x, kc, vc = _block_decode_step(ly, p, kc, vc, x, pos)
-            new_caches.append((kc, vc))
+        ly = self.blocks[0]          # conf-identical (checked in init)
+
+        def body(h, layer):
+            p, kc_l, vc_l = layer
+            h, kc_l, vc_l = _block_decode_step(ly, p, kc_l, vc_l, h, pos)
+            return h, (kc_l, vc_l)
+
+        x, (kc, vc) = jax.lax.scan(body, x, (blk_stack, kc, vc))
         logits = (x.astype(jnp.float32) @ head_p["W"] + head_p["b"])
-        return logits, new_caches
+        return logits, kc, vc
 
     def generate(self, prompt_ids, n_new: int, temperature: float = 0.0,
                  seed: int = 0, max_len: Optional[int] = None,
@@ -235,6 +291,16 @@ class TransformerGenerator:
         if (top_k is not None or top_p is not None) and temperature <= 0:
             raise ValueError("top_k/top_p need temperature > 0 "
                              "(greedy ignores the filtered tail)")
+        if top_k is not None:
+            # ADVICE r5: JAX clamps out-of-range sort indices, so
+            # top_k=0 / top_k>vocab would SILENTLY disable filtering
+            # (kth becomes the min logit); top_k is static per jit key,
+            # so a plain Python check catches it here.
+            vocab = int(np.shape(self._params()[2]["W"])[-1])
+            if not 1 <= int(top_k) <= vocab:
+                raise ValueError(
+                    f"top_k={top_k} out of range [1, {vocab}] "
+                    "(vocab size)")
         key = (b, t0, n_new, L, float(temperature), top_k,
                None if top_p is None else float(top_p))
         if key not in self._fn_cache:
@@ -257,27 +323,49 @@ class TransformerGenerator:
             _GEN_RATE.set(n_new / dt)
         return out
 
-    def _prefill(self, emb_p, blk_ps, head_p, prompt, L):
-        """Batched prompt pass: fill every block's KV cache for
-        positions < t0 and return the last position's logits."""
-        b, t0 = prompt.shape
-        dh = self.emb.n_out // self.blocks[0].n_heads
-        h = self.blocks[0].n_heads
+    def _prefill_rows(self, emb_p, blk_stack, head_p, prompt, t0=None):
+        """Batched prompt pass scanned over the stacked block params.
+        Returns (logits [b, V], ks, vs [n_layers, b, h, t, dh]) — the
+        raw per-layer K/V rows, for the caller to place (offline decode
+        zero-pads to L; the generation server scatters into a slot's
+        cache stripe).  ``t0`` picks the logits position for prompts
+        PADDED past their real length (causal masking makes position
+        t0-1 independent of the pad tail); default is the last column.
+        THE prefill numerics both decode paths share — byte-identical
+        greedy parity between them depends on exactly this."""
+        cd = self.compute_dtype
+        ly = self.blocks[0]
         x = _embed_prompt(self.emb, emb_p, prompt)
-        x = x.astype(self.compute_dtype)
-        caches = []
-        for ly, p in zip(self.blocks, blk_ps):
-            x, k, v = _block_prefill(ly, p, x)
-            kc = jnp.zeros((b, h, L, dh), self.compute_dtype)
-            vc = jnp.zeros((b, h, L, dh), self.compute_dtype)
-            kc = jax.lax.dynamic_update_slice(
-                kc, k.astype(self.compute_dtype), (0, 0, 0, 0))
-            vc = jax.lax.dynamic_update_slice(
-                vc, v.astype(self.compute_dtype), (0, 0, 0, 0))
-            caches.append((kc, vc))
-        last = x[:, -1].astype(jnp.float32)
-        logits = last @ head_p["W"] + head_p["b"]
-        return logits, caches
+        x = x.astype(cd)
+
+        def body(hdn, p):
+            hdn, k, v = _block_prefill(ly, p, hdn)
+            return hdn, (k.astype(cd), v.astype(cd))
+
+        x, (ks, vs) = jax.lax.scan(body, x, blk_stack)
+        if t0 is None:
+            last = x[:, -1]
+        else:
+            last = jax.lax.dynamic_slice_in_dim(x, t0 - 1, 1,
+                                                axis=1)[:, 0]
+        logits = last.astype(jnp.float32) @ head_p["W"] + head_p["b"]
+        return logits, ks, vs
+
+    def _prefill(self, emb_p, blk_stack, head_p, prompt, L):
+        """``_prefill_rows`` + zero-padded caches out to length L,
+        stacked [n_layers, b, h, L, dh] — ``_step``'s layout."""
+        b = prompt.shape[0]
+        h = self.blocks[0].n_heads
+        dh = self.emb.n_out // h
+        n_layers = len(self.blocks)
+        cd = self.compute_dtype
+        logits, ks, vs = self._prefill_rows(emb_p, blk_stack, head_p,
+                                            prompt)
+        kc = jnp.zeros((n_layers, b, h, L, dh), cd)
+        vc = jnp.zeros((n_layers, b, h, L, dh), cd)
+        kc = jax.lax.dynamic_update_slice(kc, ks, (0, 0, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, vs, (0, 0, 0, 0, 0))
+        return logits, kc, vc
 
     def _generate_scan(self, emb_p, blk_ps, head_p, ids, rng_key,
                        t0, n_new, L, temperature, top_k=None,
@@ -295,9 +383,10 @@ class TransformerGenerator:
                            else a), t)
             emb_p, blk_ps, head_p = cast(emb_p), cast(blk_ps), \
                 cast(head_p)
+        blk_stack = self._stack_blocks(blk_ps)
         prompt = ids[:, :t0]
-        logits0, caches = self._prefill(emb_p, blk_ps, head_p, prompt,
-                                        L)
+        logits0, kc, vc = self._prefill(emb_p, blk_stack, head_p,
+                                        prompt, L)
 
         def sample(logits, key):
             if temperature > 0.0:
@@ -311,15 +400,15 @@ class TransformerGenerator:
         def body(carry, pos):
             # sample the token AT pos from the previous logits, write
             # it, embed it, advance the caches
-            ids, caches, key, logits = carry
+            ids, kc, vc, key, logits = carry
             nxt, key = sample(logits, key)
             ids = jax.lax.dynamic_update_slice(ids, nxt[:, None],
                                                (0, pos))
-            logits, caches = self._step(emb_p, blk_ps, head_p, caches,
-                                        nxt, pos)
-            return (ids, caches, key, logits), None
+            logits, kc, vc = self._step(emb_p, blk_stack, head_p,
+                                        kc, vc, nxt, pos)
+            return (ids, kc, vc, key, logits), None
 
-        (ids, _, _, _), _ = jax.lax.scan(
-            body, (ids, caches, rng_key, logits0),
+        (ids, _, _, _, _), _ = jax.lax.scan(
+            body, (ids, kc, vc, rng_key, logits0),
             t0 + jnp.arange(n_new))
         return ids

@@ -81,6 +81,12 @@ class MultiLayerNetwork:
     def _check_init(self):
         if self.params_tree is None:
             self.init()
+        # a trainer holding the authoritative (e.g. pipeline-stacked)
+        # params installs this hook; it refreshes params_tree lazily so
+        # the per-step hot path never pays the sync (ADVICE r5 perf)
+        hook = self.__dict__.get("_param_sync_hook")
+        if hook is not None:
+            hook()
 
     # ------------------------------------------------------------------
     # Pure forward/score (traced by XLA)
@@ -422,6 +428,9 @@ class MultiLayerNetwork:
 
     def clone(self) -> "MultiLayerNetwork":
         import copy
+        hook = self.__dict__.get("_param_sync_hook")
+        if hook is not None:
+            hook()
         m = MultiLayerNetwork(MultiLayerConfiguration.from_dict(
             self.conf.to_dict()))
         if self.params_tree is not None:

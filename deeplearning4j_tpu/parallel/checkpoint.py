@@ -83,6 +83,9 @@ class CheckpointListener(TrainingListener):
         # loss trajectory (proven by test_preemption_kill_and_resume).
         it = (completed_iterations if completed_iterations is not None
               else model.iteration_count)
+        hook = getattr(model, "_param_sync_hook", None)
+        if hook is not None:   # lazily-synced trainer-owned params
+            hook()
         return {"params": model.params_tree,
                 "opt_state": model.opt_state,
                 "model_state": model.state_tree,
@@ -126,4 +129,11 @@ class CheckpointListener(TrainingListener):
         model.state_tree = state["model_state"]
         model.iteration_count = int(state["counters"]["iteration"])
         model.epoch_count = int(state["counters"]["epoch"])
+        # a lazily-synced trainer must not clobber the restored tree
+        # with a deferred unstack of PRE-restore training state (hook
+        # protocol defined in parallel/trainer.py)
+        discard = getattr(getattr(model, "_param_sync_hook", None),
+                          "discard_pending", None)
+        if discard is not None:
+            discard()
         return step

@@ -1,0 +1,490 @@
+"""GenerationServer: continuous-batching decode serving.
+
+``ParallelInference`` coalesces STATELESS forwards; a causal decoder is
+the stateful analogue — every decode tick streams the full parameter
+set from HBM regardless of how many rows ride along
+(GENERATION_r05.json measured 31.4% of the bf16 params-bandwidth ideal
+at a fixed batch of 8), so aggregate tokens/s scales almost free with
+batch until memory binds.  This module multiplexes many concurrent
+``submit()`` callers onto ONE jitted decode tick over a fixed pool of
+``n_slots`` slots sharing preallocated [n_layers, B, h, L, dh] KV
+caches — Orca-style continuous batching: requests join and leave
+mid-flight instead of waiting for the whole batch.
+
+Design:
+
+* the decode tick is ONE static-shape XLA program: per-slot
+  position / remaining-budget / EOS-id live in device-side int32 state,
+  sampling masks inactive slots, and cache writes land at per-slot
+  positions (``_block_decode_step``'s vector-``pos`` path);
+* between ticks the host scheduler admits queued requests into free
+  slots — prefill runs the existing batched causal forward
+  (``_block_prefill`` scanned over the stacked block params) with the
+  prompt padded to a power-of-two bucket (bounds prefill recompiles at
+  log2(L) variants; padded rows are never attended before being
+  overwritten by decode writes), and the resulting K/V rows are
+  scattered into the slot's cache;
+* finished slots (budget exhausted or EOS sampled) retire back to
+  their callers and free up for the next queued request.
+
+Greedy decode through the server is byte-identical to offline
+``TransformerGenerator.generate()`` per request — the tick runs the
+same stacked-params layer scan.  Sampling (``temperature``/``top_k``/
+``top_p`` are server-level knobs) draws from per-slot PRNG streams, so
+sampled outputs are reproducible per (seed, admission) but do not
+replay the offline scan's key schedule.
+
+Not here yet (ROADMAP open items): paged / non-contiguous KV blocks
+(each slot owns a contiguous [L] stripe, so max_len bounds every
+request), speculative decode, and per-request sampling params.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.models.generation import (TransformerGenerator,
+                                                  _filter_logits)
+from deeplearning4j_tpu.parallel.inference import _bucket
+
+# Serving-decode telemetry (the serve-side counterpart of the
+# parallel.inference series): slot occupancy answers "is the decode
+# pool saturated", queue depth is the backpressure a load balancer
+# watches, TTFT and per-request tokens/s are the caller-visible SLOs.
+_ADMITTED = telemetry.counter(
+    "generation_server_admitted_total",
+    "requests admitted into a decode slot (prefill done)")
+_RETIRED = telemetry.counter(
+    "generation_server_retired_total",
+    "requests retired back to their caller (budget or EOS)")
+_TICKS = telemetry.counter(
+    "generation_server_ticks_total", "jitted decode ticks dispatched")
+_SLOTS_BUSY = telemetry.gauge(
+    "generation_server_slots_busy", "slots decoding at the last tick")
+_QDEPTH = telemetry.gauge(
+    "generation_server_queue_depth",
+    "submitted requests waiting for a free slot")
+_OCC = telemetry.histogram(
+    "generation_server_slot_occupancy",
+    "active slots / n_slots per tick (params-stream amortization)",
+    buckets=telemetry.RATIO_BUCKETS)
+_TTFT = telemetry.histogram(
+    "generation_server_ttft_seconds",
+    "submit -> first generated token per request (queue wait + "
+    "prefill + first tick)")
+_RATE = telemetry.histogram(
+    "generation_server_request_tokens_per_sec",
+    "per-request generated tokens / residence seconds",
+    buckets=(1., 4., 16., 64., 256., 1024., 4096., 16384.))
+
+
+class _Pending:
+    """One submitted request.  ``result()`` blocks the caller; the
+    scheduler thread fills ``_result``/``_error`` and sets the event.
+    ``ttft`` (seconds) is populated when the first token lands."""
+
+    __slots__ = ("prompt", "n_new", "eos_id", "seed", "t_submit",
+                 "t0", "emitted", "ttft", "_result", "_error", "_event")
+
+    def __init__(self, prompt, n_new, eos_id, seed):
+        self.prompt = prompt
+        self.n_new = n_new
+        self.eos_id = eos_id
+        self.seed = seed
+        self.t_submit = time.perf_counter()
+        self.t0 = len(prompt)
+        self.emitted = 0
+        self.ttft = None
+        self._result = None
+        self._error = None
+        self._event = threading.Event()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until the request retires; returns the full sequence
+        [t0 + n_emitted] (prompt + generated, EOS included when hit)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"generation result not ready within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class GenerationServer:
+    """Thread-safe continuous-batching decode server over a causal
+    decoder MLN (same stack contract as ``TransformerGenerator``).
+
+    >>> srv = GenerationServer(net, n_slots=16, max_len=1024)
+    >>> out = srv.submit(prompt_ids, n_new=64)           # blocking
+    >>> h = srv.submit_async(prompt_ids, n_new=64)       # handle
+    >>> out = h.result(); h.ttft                         # seconds
+    >>> srv.shutdown()
+
+    ``temperature``/``top_k``/``top_p`` configure sampling for ALL
+    requests (greedy by default — byte-identical to offline
+    ``generate()``); ``eos_id`` per request stops decode early the tick
+    the token is emitted."""
+
+    def __init__(self, net, n_slots: int = 8,
+                 max_len: Optional[int] = None,
+                 compute_dtype: Optional[str] = None,
+                 temperature: float = 0.0,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 queue_limit: int = 1024):
+        self._gen = TransformerGenerator(net, compute_dtype=compute_dtype)
+        gen = self._gen
+        self.n_slots = int(n_slots)
+        if self.n_slots < 1:
+            raise ValueError("n_slots must be >= 1")
+        self.max_len = int(max_len or gen.emb.max_len)
+        if gen.emb.add_positional and self.max_len > gen.emb.max_len:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the model's positional "
+                f"table ({gen.emb.max_len} rows)")
+        if (top_k is not None or top_p is not None) and temperature <= 0:
+            raise ValueError("top_k/top_p need temperature > 0 "
+                             "(greedy ignores the filtered tail)")
+        self._vocab = int(np.shape(gen._params()[2]["W"])[-1])
+        if top_k is not None and not 1 <= int(top_k) <= self._vocab:
+            raise ValueError(f"top_k={top_k} out of range "
+                             f"[1, {self._vocab}] (vocab size)")
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
+
+        self._fresh_pool()
+        self._ids = np.zeros((self.n_slots, self.max_len),
+                             np.int32)                # host output rows
+        self.refresh_params()
+        self._tick = self._build_tick()
+        self._admit_cache = {}
+        self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue(
+            maxsize=queue_limit)
+        self._shutdown = False
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _fresh_pool(self):
+        """(Re)allocate the KV caches and per-slot device state — every
+        slot inactive.  Also the error-recovery reset: the tick/admit
+        programs DONATE these buffers, so after a failed dispatch the
+        old arrays may already be invalidated."""
+        gen = self._gen
+        B, L = self.n_slots, self.max_len
+        h = gen.blocks[0].n_heads
+        dh = gen.emb.n_out // h
+        n_layers = len(gen.blocks)
+        cd = gen.compute_dtype
+        self._kc = jnp.zeros((n_layers, B, h, L, dh), cd)
+        self._vc = jnp.zeros((n_layers, B, h, L, dh), cd)
+        self._state = {
+            "pos": jnp.zeros((B,), jnp.int32),        # next write index
+            "remaining": jnp.zeros((B,), jnp.int32),  # tokens to emit
+            "eos": jnp.full((B,), -1, jnp.int32),     # -1 disables
+            "logits": jnp.zeros((B, self._vocab), jnp.float32),
+            "key": jnp.zeros((B, 2), jnp.uint32),     # per-slot PRNG
+        }
+
+    # -- public API ----------------------------------------------------
+    def refresh_params(self):
+        """Snapshot the net's params for serving: block params stacked
+        on the [n_layers] scan axis and (when the server computes in
+        bf16) every floating leaf cast ONCE — the decode tick re-reads
+        every parameter each tick, and streaming f32-stored weights
+        would cost 2x the bytes of the math performed.  Call again
+        after the underlying net's weights change."""
+        gen = self._gen
+        emb_p, blk_ps, head_p = gen._params()
+        blk_stack = gen._stack_blocks(blk_ps)
+        if gen.compute_dtype != jnp.float32:
+            cd = gen.compute_dtype
+            cast = lambda t: jax.tree_util.tree_map(
+                lambda a: (a.astype(cd)
+                           if jnp.issubdtype(a.dtype, jnp.floating)
+                           else a), t)
+            emb_p, blk_stack, head_p = (cast(emb_p), cast(blk_stack),
+                                        cast(head_p))
+        self._params = (emb_p, blk_stack, head_p)
+
+    def submit_async(self, prompt_ids, n_new: int,
+                     eos_id: Optional[int] = None,
+                     seed: int = 0) -> _Pending:
+        """Enqueue one sequence; returns a handle whose ``result()``
+        blocks.  ``prompt_ids`` is a 1-D int array; the request decodes
+        until ``n_new`` tokens are emitted or ``eos_id`` is sampled."""
+        if self._shutdown:
+            raise RuntimeError("GenerationServer has been shut down")
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError("prompt_ids must be a non-empty 1-D int "
+                             f"array, got shape {prompt.shape}")
+        n_new = int(n_new)
+        if n_new < 1:
+            raise ValueError("n_new must be >= 1")
+        if len(prompt) + n_new > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + n_new ({n_new}) exceeds the "
+                f"slot cache length ({self.max_len})")
+        req = _Pending(prompt, n_new,
+                       -1 if eos_id is None else int(eos_id), int(seed))
+        while True:
+            try:
+                self._queue.put(req, timeout=0.1)
+                break
+            except queue.Full:
+                if self._shutdown:   # nobody will ever drain a slot
+                    raise RuntimeError(
+                        "GenerationServer has been shut down") from None
+        if self._shutdown and not self._worker.is_alive():
+            # raced shutdown(): the put may have landed AFTER the
+            # worker's (and shutdown's) final drains — fail leftovers
+            # ourselves so no caller's result() blocks forever
+            self._fail_leftovers()
+        return req
+
+    def submit(self, prompt_ids, n_new: int,
+               eos_id: Optional[int] = None, seed: int = 0,
+               timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking ``submit_async().result()``."""
+        return self.submit_async(prompt_ids, n_new, eos_id,
+                                 seed).result(timeout)
+
+    def _fail_leftovers(self):
+        """Drain and fail queued requests once the worker is gone —
+        whichever of shutdown()/submit_async() observes the dead worker
+        last runs this, so no request is stranded unconsumed."""
+        err = RuntimeError("GenerationServer shut down with the "
+                           "request in flight")
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None:
+                self._retire(item, -1, error=err)
+
+    def shutdown(self):
+        """Stop the scheduler.  In-flight and queued requests fail with
+        RuntimeError — collect results before shutting down."""
+        self._shutdown = True
+        self._queue.put(None)
+        self._worker.join(timeout=30)
+        # a submit that passed the _shutdown check concurrently may
+        # have enqueued AFTER the sentinel (the worker exits on the
+        # first None it sees)
+        self._fail_leftovers()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- compiled programs ---------------------------------------------
+    def _build_tick(self):
+        """ONE static-shape decode tick over all B slots: sample each
+        active slot's next token from its held logits, write it at the
+        slot's position, advance every cache one step, decrement
+        budgets, zero the budget on EOS.  Inactive slots flow through
+        with a masked write at their stale position — rows beyond a
+        slot's live prefix are never attended before being rewritten,
+        so the garbage is unreachable."""
+        gen = self._gen
+        temp, tk, tp = self.temperature, self.top_k, self.top_p
+
+        def tick(emb_p, blk_stack, head_p, kc, vc, state):
+            active = state["remaining"] > 0
+            logits = state["logits"]
+            if temp > 0.0:
+                both = jax.vmap(jax.random.split)(state["key"])
+                keys, subs = both[:, 0], both[:, 1]
+                lg = _filter_logits(logits / temp, tk, tp)
+                tok = jax.vmap(jax.random.categorical)(subs, lg)
+            else:
+                keys = state["key"]
+                tok = jnp.argmax(logits, axis=-1)
+            tok = jnp.where(active, tok, 0).astype(jnp.int32)
+            # inactive slots step at position 0, NOT their stale pos: a
+            # just-finished max-length request parks pos == max_len,
+            # and an out-of-bounds positional-table take fills NaN —
+            # which the clamped cache write would smear into row L-1
+            # and poison the slot's next request (0*NaN = NaN through
+            # the attention mask).  Row 0 of a FREE slot is always
+            # rewritten by admission prefill before any read.
+            pos = jnp.where(active, state["pos"], 0)
+            new_logits, kc, vc = gen._step(emb_p, blk_stack, head_p,
+                                           kc, vc, tok, pos)
+            hit_eos = active & (tok == state["eos"])
+            remaining = jnp.where(active, state["remaining"] - 1, 0)
+            remaining = jnp.where(hit_eos, 0, remaining)
+            state = {
+                "pos": jnp.where(active, state["pos"] + 1, state["pos"]),
+                "remaining": remaining,
+                "eos": state["eos"],
+                "logits": jnp.where(active[:, None], new_logits, logits),
+                "key": keys,
+            }
+            return kc, vc, state, tok
+
+        # donate caches + state: the tick updates them in place instead
+        # of copying both full [n_layers, B, h, L, dh] buffers per
+        # token (ignored with a warning on backends without donation)
+        return jax.jit(tick, donate_argnums=(3, 4, 5))
+
+    def _admit_fn(self, tb: int):
+        """Admission program for prefill bucket ``tb`` (cached per
+        bucket): batched causal prefill of the padded prompt, K/V rows
+        scattered into the slot's cache stripe, slot state armed."""
+        if tb in self._admit_cache:
+            return self._admit_cache[tb]
+        gen = self._gen
+
+        def admit(emb_p, blk_stack, head_p, kc, vc, state, prompt, t0,
+                  slot, n_new, eos_id, key):
+            # the SAME prefill program offline decode runs (parity
+            # depends on it); t0 picks the last REAL position's logits
+            # out of the padded bucket
+            logits, ks, vs = gen._prefill_rows(emb_p, blk_stack,
+                                               head_p, prompt, t0)
+            kc = jax.lax.dynamic_update_slice(kc, ks, (0, slot, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(vc, vs, (0, slot, 0, 0, 0))
+            state = {
+                "pos": state["pos"].at[slot].set(t0),
+                "remaining": state["remaining"].at[slot].set(n_new),
+                "eos": state["eos"].at[slot].set(eos_id),
+                "logits": jax.lax.dynamic_update_slice(
+                    state["logits"], logits, (slot, 0)),
+                "key": jax.lax.dynamic_update_slice(
+                    state["key"], key[None], (slot, 0)),
+            }
+            return kc, vc, state
+
+        fn = self._admit_cache[tb] = jax.jit(admit,
+                                             donate_argnums=(3, 4, 5))
+        return fn
+
+    # -- scheduler -----------------------------------------------------
+    def _admit(self, req: _Pending, slot: int):
+        tb = _bucket(req.t0, self.max_len)
+        padded = np.zeros((1, tb), np.int32)
+        padded[0, :req.t0] = req.prompt
+        emb_p, blk_stack, head_p = self._params
+        self._kc, self._vc, self._state = self._admit_fn(tb)(
+            emb_p, blk_stack, head_p, self._kc, self._vc, self._state,
+            jnp.asarray(padded), np.int32(req.t0), np.int32(slot),
+            np.int32(req.n_new), np.int32(req.eos_id),
+            jax.random.PRNGKey(req.seed))
+        self._ids[slot, :req.t0] = req.prompt
+        _ADMITTED.inc()
+
+    def _retire(self, req: _Pending, slot: int, error=None):
+        if error is not None:
+            req._error = error
+        else:
+            req._result = self._ids[slot, :req.t0 + req.emitted].copy()
+            dt = time.perf_counter() - req.t_submit
+            if dt > 0:
+                _RATE.observe(req.emitted / dt)
+        _RETIRED.inc()
+        req._event.set()
+
+    def _run(self):
+        tracer = telemetry.get_tracer()
+        pending = []             # admitted-order wait line (host side)
+        active = {}              # slot -> request
+        free = list(range(self.n_slots - 1, -1, -1))
+        stop = False
+        while True:
+            # ingest: block only when idle, else drain without waiting
+            if not active and not pending:
+                item = self._queue.get()
+                if item is None:
+                    stop = True
+                else:
+                    pending.append(item)
+            while not stop:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None:
+                    stop = True
+                else:
+                    pending.append(item)
+            if stop:
+                err = RuntimeError("GenerationServer shut down with the "
+                                   "request in flight")
+                while True:      # requests enqueued behind the sentinel
+                    try:
+                        item = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is not None:
+                        pending.append(item)
+                for slot, req in active.items():
+                    self._retire(req, slot, error=err)
+                for req in pending:
+                    self._retire(req, -1, error=err)
+                _SLOTS_BUSY.set(0)
+                _QDEPTH.set(0)
+                return
+            try:
+                while free and pending:
+                    req = pending.pop(0)
+                    slot = free.pop()
+                    self._admit(req, slot)
+                    active[slot] = req
+                _QDEPTH.set(len(pending) + self._queue.qsize())
+                _SLOTS_BUSY.set(len(active))
+                if not active:
+                    continue
+                emb_p, blk_stack, head_p = self._params
+                with tracer.span("serve/tick", active=len(active),
+                                 queued=len(pending)):
+                    self._kc, self._vc, self._state, tok = self._tick(
+                        emb_p, blk_stack, head_p, self._kc, self._vc,
+                        self._state)
+                    tok_h = np.asarray(tok)
+                    rem_h = np.asarray(self._state["remaining"])
+                _TICKS.inc()
+                _OCC.observe(len(active) / self.n_slots)
+                now = time.perf_counter()
+                for slot in list(active):
+                    req = active[slot]
+                    self._ids[slot, req.t0 + req.emitted] = tok_h[slot]
+                    req.emitted += 1
+                    if req.ttft is None:
+                        req.ttft = now - req.t_submit
+                        _TTFT.observe(req.ttft)
+                    if rem_h[slot] == 0:
+                        self._retire(req, slot)
+                        del active[slot]
+                        free.append(slot)
+                # post-tick refresh so an idle pool scrapes as 0 busy
+                # (the loop blocks on the queue next, with no tick to
+                # update the gauges)
+                _SLOTS_BUSY.set(len(active))
+                _QDEPTH.set(len(pending) + self._queue.qsize())
+            except Exception as e:  # surface to every blocked caller
+                for slot, req in active.items():
+                    self._retire(req, slot, error=e)
+                for req in pending:
+                    self._retire(req, -1, error=e)
+                active.clear()
+                pending.clear()
+                free = list(range(self.n_slots - 1, -1, -1))
+                # the failed dispatch may have consumed the donated
+                # buffers mid-update: rebuild a clean inactive pool
+                self._fresh_pool()

@@ -172,6 +172,12 @@ class ParallelInference:
                 if b > n:  # pad to the bucket to bound recompiles
                     pad = np.zeros((b - n,) + batch.shape[1:], batch.dtype)
                     batch = np.concatenate([batch, pad], axis=0)
+                # a lazily-synced trainer (pipeline path) defers its
+                # unstack to this hook — without it a train-while-serve
+                # loop would serve init-time weights forever
+                hook = getattr(self.model, "_param_sync_hook", None)
+                if hook is not None:
+                    hook()
                 with tracer.span("serve/forward", requests=len(reqs),
                                  examples=n, bucket=b):
                     out = self._apply(self.model.params_tree,

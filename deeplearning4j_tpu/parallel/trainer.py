@@ -310,6 +310,32 @@ class ShardedTrainer:
             return params, opt_state, loss
 
         self._pipe_step = jax.jit(step, donate_argnums=(0, 1))
+        # ADVICE r5 perf: unstacking every pipelined block back into
+        # the model tree after EVERY step is host-side overhead on the
+        # hot path that grows with model size.  Sync lazily instead:
+        # steps mark the model tree stale, and the unstack runs only
+        # when something actually reads it — model.output()/score()/
+        # serialization reach sync_model through this hook.  The hook
+        # holds the trainer WEAKLY: a model outliving its trainer must
+        # not pin the stacked pipe params + optimizer state in memory.
+        import weakref
+        self._model_stale = False
+        wr = weakref.ref(self)
+
+        def _hook():
+            tr = wr()
+            if tr is not None:
+                tr.sync_model()
+
+        def _discard_pending():
+            # hook protocol: after an external restore overwrites the
+            # model tree, drop any deferred unstack so it cannot
+            # clobber the restored weights (parallel/checkpoint.py)
+            tr = wr()
+            if tr is not None:
+                tr._model_stale = False
+        _hook.discard_pending = _discard_pending
+        model._param_sync_hook = _hook
 
     def _pipe_reg(self, params):
         """l1/l2 over all layers from the TRACED params — a sum over a
@@ -341,9 +367,13 @@ class ShardedTrainer:
 
     def sync_model(self):
         """Unstack the pipelined params back into the model's tree so
-        ``output``/serialization see the trained weights."""
-        if self._pipe is None:
+        ``output``/serialization see the trained weights.  Lazy: a
+        no-op unless a pipelined step ran since the last sync (the
+        model's ``_param_sync_hook`` calls this on demand, so the
+        per-step hot path never pays the unstack)."""
+        if self._pipe is None or not self._model_stale:
             return
+        self._model_stale = False
         lo, hi = self._pipe
         m = self.model
         p = self._pipe_params
@@ -382,6 +412,7 @@ class ShardedTrainer:
                 (self._pipe_params, self._pipe_opt, loss) = \
                     self._pipe_step(self._pipe_params, self._pipe_opt,
                                     m.iteration_count, batch)
+            self._model_stale = True
             self._step_counter.inc()   # dispatched, not failed validation
             return loss
         batch = self._shard_batch(batch)
@@ -406,10 +437,12 @@ class ShardedTrainer:
                   labels_mask=None):
         """One global step: shard inputs, run the compiled step, return
         loss.  Equivalent to one synchronized ParallelWrapper averaging
-        round — except synchronization is an XLA all-reduce over ICI."""
+        round — except synchronization is an XLA all-reduce over ICI.
+        On the pipeline path the model's own tree syncs LAZILY (the
+        unstack runs when ``output``/serialization next reads it, not
+        per step)."""
         loss = self._step_batch(features, labels, features_mask, labels_mask)
         self.model.iteration_count += 1
-        self.sync_model()
         return loss
 
     def fit(self, iterator, n_epochs: int = 1):

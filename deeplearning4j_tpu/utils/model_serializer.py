@@ -86,6 +86,9 @@ def _tree_from_npz(data: bytes):
 
 def write_model(model, path, save_updater: bool = True) -> None:
     """DL4J ``ModelSerializer.writeModel(model, file, saveUpdater)``."""
+    hook = getattr(model, "_param_sync_hook", None)
+    if hook is not None:   # lazily-synced trainer-owned params
+        hook()
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr(_CONFIG, json.dumps(model.conf.to_dict(), indent=2))
         zf.writestr(_PARAMS, _npz_bytes(model.params_tree or {}))

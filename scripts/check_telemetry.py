@@ -8,7 +8,8 @@ Fails (exit 1) when:
 * any histogram sum is NaN,
 * a required series is missing (``inference_latency_seconds`` buckets,
   ``flash_route_total{path=...}``, the ``mfu`` gauge, the fit loop's
-  data-wait/step split), or
+  data-wait/step split, the ``generation_server_*`` serve-decode
+  series), or
 * the exported span trace or the report embedding is empty.
 
 Runs on CPU inside the tier-1 budget (tiny MLP, seconds) — wired into
@@ -95,6 +96,31 @@ def main() -> int:
             t.join()
         problems += errs
 
+    # -- serve decode: 3 requests through 2 slots (exercises the
+    # continuous-batching queue) -------------------------------------
+    from deeplearning4j_tpu.parallel import GenerationServer
+    from deeplearning4j_tpu.zoo.gpt import Gpt
+
+    retired = registry.counter("generation_server_retired_total")
+    retired_before = retired.value
+    gpt = Gpt(vocab_size=50, max_len=32, d_model=32, n_layers=2,
+              n_heads=4, d_ff=64, seq_len=8, compute_dtype=None,
+              seed=3).init_graph()
+    with GenerationServer(gpt, n_slots=2, max_len=32) as gs:
+        gh = [gs.submit_async(np.asarray([1, 2, 3, 4], np.int32),
+                              n_new=4) for _ in range(3)]
+        for i, handle in enumerate(gh):
+            try:
+                out = handle.result(timeout=300)
+                if out.shape != (8,):
+                    problems.append(
+                        f"generation request {i}: shape {out.shape}")
+            except Exception as e:  # pragma: no cover - smoke surface
+                problems.append(f"generation request {i}: {e}")
+    if retired.value - retired_before != 3:
+        problems.append(f"generation_server_retired_total grew "
+                        f"{retired.value - retired_before} != 3")
+
     # -- scrape over HTTP ----------------------------------------------
     with telemetry.start_metrics_server(registry, port=0) as srv:
         body = urllib.request.urlopen(
@@ -118,6 +144,12 @@ def main() -> int:
         "mfu ",
         "train_data_wait_seconds_bucket",
         "train_step_dispatch_seconds_bucket",
+        "generation_server_admitted_total",
+        "generation_server_retired_total",
+        "generation_server_ttft_seconds_bucket",
+        "generation_server_slots_busy",
+        "generation_server_slot_occupancy_bucket",
+        "generation_server_ticks_total",
     ]
     for needle in required:
         if needle not in body:

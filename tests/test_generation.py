@@ -45,11 +45,12 @@ def test_cached_logits_match_full_forward():
     prompt = rng.integers(0, 50, (1, 5)).astype(np.int32)
     import jax.numpy as jnp
     emb_p, blk_ps, head_p = gen._params()
-    caches = [(jnp.zeros((1, 4, 8, 8)), jnp.zeros((1, 4, 8, 8)))
-              for _ in gen.blocks]
+    blk_stack = gen._stack_blocks(blk_ps)
+    kc = jnp.zeros((len(gen.blocks), 1, 4, 8, 8))
+    vc = jnp.zeros((len(gen.blocks), 1, 4, 8, 8))
     logits = None
     for pos in range(prompt.shape[1]):
-        logits, caches = gen._step(emb_p, blk_ps, head_p, caches,
+        logits, kc, vc = gen._step(emb_p, blk_stack, head_p, kc, vc,
                                    jnp.asarray(prompt[:, pos]), pos)
     import jax
     full_probs = np.asarray(net.output(prompt))[:, -1]
