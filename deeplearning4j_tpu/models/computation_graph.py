@@ -564,9 +564,12 @@ class ComputationGraph:
             b["labels_mask"] = lmask
         return b
 
-    def fit(self, data, n_epochs: int = 1, async_prefetch: bool = True):
+    def fit(self, data, n_epochs: int = 1, async_prefetch: bool = True,
+            resume: bool = False):
         """Train on a DataSet / MultiDataSet / iterator (DL4J
-        ``ComputationGraph.fit`` overloads)."""
+        ``ComputationGraph.fit`` overloads).  ``resume=True`` restores
+        the newest checkpoint from an attached ``CheckpointListener``
+        first (``n_epochs`` is then the TOTAL epoch target)."""
         self._check_init()
         self._build_solver()
         if isinstance(data, (DataSet, MultiDataSet)):
@@ -579,7 +582,8 @@ class ComputationGraph:
                        iterator, AsyncDataSetIterator)
                    else iterator)
 
-        return run_fit(self, wrapped, n_epochs, reset_target=iterator)
+        return run_fit(self, wrapped, n_epochs, reset_target=iterator,
+                       resume=resume)
 
     def compiled_train_step(self):
         """A reusable jitted full train step operating on a ``TrainState``

@@ -226,10 +226,12 @@ class MultiLayerNetwork:
         return b
 
     def fit(self, data: Union[DataSet, DataSetIterator], n_epochs: int = 1,
-            async_prefetch: bool = True):
+            async_prefetch: bool = True, resume: bool = False):
         """Train (DL4J ``fit(DataSetIterator, numEpochs)`` /
         ``fit(DataSet)``).  Wraps the iterator in async prefetch exactly as
-        DL4J wraps in ``AsyncDataSetIterator``."""
+        DL4J wraps in ``AsyncDataSetIterator``.  ``resume=True`` restores
+        the newest checkpoint from an attached ``CheckpointListener``
+        first (``n_epochs`` is then the TOTAL epoch target)."""
         self._check_init()
         self._build_solver()
         if isinstance(data, DataSet):
@@ -244,7 +246,8 @@ class MultiLayerNetwork:
                        iterator, AsyncDataSetIterator)
                    else iterator)
 
-        return run_fit(self, wrapped, n_epochs, reset_target=iterator)
+        return run_fit(self, wrapped, n_epochs, reset_target=iterator,
+                       resume=resume)
 
     # ------------------------------------------------------------------
     # Recurrent state management (DL4J rnnTimeStep / tBPTT semantics)

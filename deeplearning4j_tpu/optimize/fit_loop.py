@@ -6,13 +6,31 @@ epoch listeners, tBPTT segmentation, iteration listeners firing BEFORE the
 counter increments (so checkpoints record the step they were taken at),
 recurrent-carry clearing between batches — lives once, parameterized by
 the step function (plain solver step, or the sharded-mesh step).
+
+Fault tolerance rides the same single loop (resilience layer):
+
+* ``resume=True`` restores the newest checkpoint from the attached
+  ``CheckpointListener`` and fast-forwards the iterator to the exact
+  batch, so a restarted process replays nothing and skips nothing;
+* a SIGTERM/SIGINT (see ``resilience.PreemptionGuard``) is polled at
+  step boundaries: the loop forces one final checkpoint save + wait,
+  then unwinds with ``TrainingPreempted``;
+* the chaos injector's training sites live here (step exceptions,
+  NaN-poisoned batches, data stalls, simulated preemption) so injected
+  faults traverse exactly the code real ones would.
 """
 from __future__ import annotations
 
+import logging
 import time
 from typing import Callable, Optional
 
 from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience import preemption as _preemption
+from deeplearning4j_tpu.resilience.errors import TrainingPreempted
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 # Structural fit-loop telemetry — fires for EVERY training entry point
 # (plain fit, ShardedTrainer, tBPTT) without any listener attached.
@@ -35,15 +53,59 @@ _STEP_TIME = telemetry.histogram(
     "sync, not device completion)")
 
 
+def _checkpoint_listener(model):
+    """The first CheckpointListener attached to the model, or None.
+    Lazy import: the parallel package imports this module."""
+    from deeplearning4j_tpu.parallel.checkpoint import CheckpointListener
+    for lst in model.listeners:
+        if isinstance(lst, CheckpointListener):
+            return lst
+    return None
+
+
+def _preemption_save(ck, model) -> Optional[int]:
+    """Force the final pre-exit checkpoint: save at the just-completed
+    iteration (unless a periodic hook this step already did) and BLOCK
+    until every async shard write lands — the one save that must not
+    be in flight when the process dies.  Returns the newest step on
+    disk (None without a checkpointer: state is lost)."""
+    if ck is None:
+        log.warning("preempted with no CheckpointListener attached — "
+                    "training state is NOT saved")
+        return None
+    label = model.iteration_count - 1
+    try:
+        if label >= 0 and label not in ck.ckpt.all_steps():
+            ck.ckpt.save(label, ck._state(model), force=True)
+        # wait() can also re-raise an EARLIER async write's failure —
+        # that must not mask TrainingPreempted (resume falls back to
+        # the newest checkpoint that did land)
+        ck.ckpt.wait()
+    except Exception:
+        log.exception("forced preemption checkpoint at step %d failed; "
+                      "resume will use the previous one", label)
+    steps = ck.ckpt.all_steps()
+    return steps[-1] if steps else None
+
+
 def run_fit(model, iterator, n_epochs: int,
             step_fn: Optional[Callable] = None,
-            reset_target=None) -> Optional[float]:
+            reset_target=None, resume: bool = False) -> Optional[float]:
     """Drive ``step_fn(batch_dict) -> loss`` over an iterator for
     ``n_epochs``.  ``model`` supplies listeners/counters/_batch_dict;
     ``reset_target`` is the iterator whose ``reset()`` is called at epoch
     end (the unwrapped iterator when async prefetch is stacked on top).
     Without ``step_fn`` the model's own solver step is used (the plain
-    single-device path); ShardedTrainer passes its mesh step."""
+    single-device path); ShardedTrainer passes its mesh step.
+
+    ``resume=True`` restores the newest checkpoint from the attached
+    ``CheckpointListener`` (params, optimizer state, counters, RNG
+    stream) and fast-forwards the iterator past the batches the
+    checkpointed epoch already consumed — the continuation is
+    bit-identical to the uninterrupted run at batch granularity.  In
+    resume mode ``n_epochs`` is the TOTAL epoch target, not an
+    increment: a run preempted in epoch 3 of 5 resumes for the
+    remaining 2."""
     from deeplearning4j_tpu.data.dataset import tbptt_segments
 
     if step_fn is None:
@@ -51,20 +113,52 @@ def run_fit(model, iterator, n_epochs: int,
             (model.params_tree, model.opt_state, model.state_tree,
              loss) = model._solver.step(
                 model.params_tree, model.opt_state, model.state_tree,
-                model.iteration_count, batch, model._rng.next_key())
+                model.iteration_count, batch, model._rng.next_key(),
+                lr_scale=getattr(model, "_lr_backoff", 1.0))
             return loss
+
+    skip_batches = 0
+    if resume:
+        ck = _checkpoint_listener(model)
+        if ck is None:
+            raise ValueError("resume=True requires a CheckpointListener "
+                             "among model.listeners")
+        step = ck.restore_into(model)
+        if step is not None:
+            skip_batches = int(getattr(model, "batch_in_epoch", 0))
+            _preemption.RESUMES.inc()
+            log.info("resumed from checkpoint step %d (epoch %d, "
+                     "%d batches into it)", step, model.epoch_count,
+                     skip_batches)
+        if model.epoch_count >= n_epochs:
+            return None
+        epochs_to_run = n_epochs - model.epoch_count
+    else:
+        epochs_to_run = n_epochs
 
     tbptt_len = (model.conf.tbptt_fwd_length
                  if getattr(model.conf, "backprop_type", "standard")
                  == "truncated_bptt" else 0)
     last_loss = None
     tracer = telemetry.get_tracer()
-    for _ in range(n_epochs):
+    for _ in range(epochs_to_run):
         for lst in model.listeners:
             lst.on_epoch_start(model, model.epoch_count)
         data_it = iter(iterator)
+        if skip_batches:
+            # resumed mid-epoch: fast-forward past the batches the
+            # checkpointed position already consumed
+            for _ in range(skip_batches):
+                try:
+                    next(data_it)
+                except StopIteration:
+                    break
+            skip_batches = 0
+        else:
+            model.batch_in_epoch = 0
         while True:
             t_fetch = time.perf_counter()
+            _faults.maybe_stall("data_stall", model.iteration_count)
             try:
                 ds = next(data_it)
             except StopIteration:
@@ -73,12 +167,23 @@ def run_fit(model, iterator, n_epochs: int,
             model.last_batch_size = ds.num_examples()
             _EXAMPLES.inc(model.last_batch_size)
             chunks = tbptt_segments(ds, tbptt_len) if tbptt_len else [ds]
-            for chunk in chunks:
+            for ci, chunk in enumerate(chunks):
                 t_step = time.perf_counter()
+                batch = _faults.corrupt_batch(model.iteration_count,
+                                              model._batch_dict(chunk))
+                _faults.maybe_fail("step_exception",
+                                   model.iteration_count)
                 with tracer.span("train/step",
                                  iteration=model.iteration_count):
-                    loss = step_fn(model._batch_dict(chunk))
+                    loss = step_fn(batch)
                 last_loss = loss
+                # batch_in_epoch counts COMPLETED batches and advances
+                # with the batch's LAST chunk, BEFORE listeners fire —
+                # so a checkpoint taken in iteration_done stores a
+                # batch position consistent with its step counter.
+                if ci == len(chunks) - 1:
+                    model.batch_in_epoch = \
+                        getattr(model, "batch_in_epoch", 0) + 1
                 # Listeners fire BEFORE the counter increments, so a
                 # checkpoint taken in iteration_done records the step it
                 # was taken at and resume agrees exactly.
@@ -88,6 +193,20 @@ def run_fit(model, iterator, n_epochs: int,
                 _STEP_TIME.observe(time.perf_counter() - t_step)
                 _ITERS.inc()
                 model.iteration_count += 1
+                # chaos site: simulated SIGTERM after iteration N
+                if _faults.fires("preempt", model.iteration_count - 1):
+                    _preemption.request_preemption()
+                # act on preemption only at BATCH boundaries: a forced
+                # save mid-batch (tBPTT chunk) would store an
+                # iteration/RNG position the batch-granular
+                # batch_in_epoch cannot express, and resume would
+                # replay chunks under shifted step indices
+                if ci == len(chunks) - 1 and \
+                        _preemption.preemption_requested():
+                    _preemption.PREEMPTIONS.inc()
+                    final = _preemption_save(_checkpoint_listener(model),
+                                             model)
+                    raise TrainingPreempted(final)
             # Recurrent carry flows ACROSS tBPTT chunks of one batch (that
             # is the point of truncated BPTT) but never across batches.
             if model._has_rnn():
@@ -95,6 +214,7 @@ def run_fit(model, iterator, n_epochs: int,
         # Increment BEFORE epoch listeners so a checkpoint taken in
         # on_epoch_end records "N epochs completed" and resumes exactly.
         model.epoch_count += 1
+        model.batch_in_epoch = 0
         _EPOCHS.inc()
         for lst in model.listeners:
             lst.on_epoch_end(model, model.epoch_count - 1)

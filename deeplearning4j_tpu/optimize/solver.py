@@ -49,6 +49,41 @@ def check_numerics(loss, params, step_idx: int):
             f"(DL4J_TPU_CHECK_NUMERICS): " + "; ".join(bad[:8]))
 
 
+def finite_step_ok(loss, grads, trainable_tree=None):
+    """Scalar bool tracer: True iff the loss and every (trainable)
+    gradient leaf are finite.  Exact per-leaf ``isfinite`` — a sum
+    probe can overflow on large finite trees and false-positive;
+    FROZEN leaves (``trainable_tree`` mask 0) are excluded — their
+    grads are zeroed downstream and must not veto the step."""
+    ok = jnp.isfinite(loss)
+    mask_leaves = (jax.tree_util.tree_leaves(trainable_tree)
+                   if trainable_tree is not None else None)
+    for i, g in enumerate(jax.tree_util.tree_leaves(grads)):
+        if mask_leaves is not None:
+            g = jnp.where(mask_leaves[i] > 0, g, jnp.zeros_like(g))
+        ok = ok & jnp.isfinite(g).all()
+    return ok
+
+
+def apply_updates_if(ok, params, updates, lr_scale):
+    """``params - updates * lr_scale`` where ``ok``, else the old
+    params.  ``lr_scale`` is the bad-step policy's backoff multiplier
+    (cast per-leaf: bf16 updates stay bf16); ``jnp.where`` — not a
+    multiply — skips the bad step, since ``0 * NaN`` would smear NaN
+    into the params."""
+    return jax.tree_util.tree_map(
+        lambda p, u: jnp.where(ok, p - (u * lr_scale).astype(u.dtype),
+                               p), params, updates)
+
+
+def select_step(ok, new_tree, old_tree):
+    """Per-leaf select between the post-step and pre-step tree (same
+    structure required) — how optimizer/model state sits out a
+    non-finite step."""
+    return jax.tree_util.tree_map(
+        lambda new, old: jnp.where(ok, new, old), new_tree, old_tree)
+
+
 def normalize_gradients(grads, kind: Optional[str], threshold: float):
     """DL4J ``GradientNormalization`` semantics
     (``org.deeplearning4j.nn.conf.GradientNormalization``)."""
@@ -126,7 +161,8 @@ class Solver:
     def init_opt_state(self, params):
         return self.updater.init_state(params)
 
-    def _step_impl(self, params, opt_state, model_state, step_idx, batch, rng):
+    def _step_impl(self, params, opt_state, model_state, step_idx, batch,
+                   rng, lr_scale):
         def loss_of(p):
             loss, new_state = self.score_fn(p, model_state, batch, rng, True)
             return (loss if self.minimize else -loss), new_state
@@ -135,6 +171,12 @@ class Solver:
             loss_of, has_aux=True)(params)
         if not self.minimize:
             loss = -loss  # report the true (maximized) score, not -score
+        # Bad-step guard (resilience layer): a non-finite loss or any
+        # non-finite gradient must not move params / optimizer state /
+        # model state — the loss is still RETURNED non-finite so the
+        # host-side BadStepPolicy sees it and applies LR backoff or
+        # rollback.  The reduction costs nothing next to the backward.
+        ok = finite_step_ok(loss, grads, self.trainable_tree)
         if self.trainable_tree is not None:
             # zero frozen grads BEFORE normalization and the updater:
             # they must not inflate clip_global_norm or accumulate
@@ -144,6 +186,7 @@ class Solver:
                 lambda g, m: g * m, grads, self.trainable_tree)
         grads = normalize_gradients(
             grads, self.grad_normalization, self.grad_norm_threshold)
+        old_opt_state = opt_state
         updates, opt_state = self.updater.update(grads, opt_state, params, step_idx)
         if self.decay_tree is not None:
             lr = self.updater.lr_at(step_idx)
@@ -155,15 +198,29 @@ class Solver:
             # must not move frozen leaves either
             updates = jax.tree_util.tree_map(
                 lambda u, m: u * m, updates, self.trainable_tree)
-        params = jax.tree_util.tree_map(lambda p, u: p - u, params, updates)
+        params = apply_updates_if(ok, params, updates, lr_scale)
         opt_state = self.updater.finalize(opt_state, params)
+        opt_state = select_step(ok, opt_state, old_opt_state)
+        # model state (batchnorm stats, rnn carry) keeps its old value
+        # on a bad step too — but only when the structures line up: an
+        # RNN's first chunk GROWS the state tree (empty -> carry), and
+        # that structural change must go through regardless (the carry
+        # of a skipped step is cleared at the next batch boundary).
+        if jax.tree_util.tree_structure(new_model_state) == \
+                jax.tree_util.tree_structure(model_state):
+            new_model_state = select_step(ok, new_model_state,
+                                          model_state)
         return params, opt_state, new_model_state, loss
 
-    def step(self, params, opt_state, model_state, step_idx, batch, rng):
+    def step(self, params, opt_state, model_state, step_idx, batch, rng,
+             lr_scale: float = 1.0):
         """One optimization iteration; returns (params, opt_state,
-        model_state, loss).  Donated inputs must not be reused by caller."""
+        model_state, loss).  Donated inputs must not be reused by caller.
+        ``lr_scale`` multiplies the final update (BadStepPolicy backoff);
+        passed traced, so changing it does not recompile."""
         out = self._step(params, opt_state, model_state,
-                         jnp.asarray(step_idx, jnp.int32), batch, rng)
+                         jnp.asarray(step_idx, jnp.int32), batch, rng,
+                         float(lr_scale))
         if check_numerics_enabled():
             check_numerics(out[3], out[0], int(step_idx))
         return out

@@ -14,11 +14,24 @@ import logging
 from pathlib import Path
 from typing import Any, Optional
 
-import orbax.checkpoint as ocp
+try:
+    import orbax.checkpoint as ocp
+    _ORBAX_IMPORT_ERROR = None
+except Exception as _e:  # degrade at import, fail loudly on first USE:
+    ocp = None           # `from parallel import ...` must keep working
+    _ORBAX_IMPORT_ERROR = _e   # on images without orbax baked in
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.resilience import faults as _faults
 
 log = logging.getLogger("deeplearning4j_tpu")
+
+_SAVES = telemetry.counter(
+    "checkpoint_saves_total", "sharded checkpoint saves initiated")
+_FAILURES = telemetry.counter(
+    "checkpoint_failures_total",
+    "periodic checkpoint saves that raised (training continued)")
 
 
 class ShardedCheckpointer:
@@ -27,6 +40,11 @@ class ShardedCheckpointer:
     completes or is discarded atomically by orbax)."""
 
     def __init__(self, directory, keep_last: int = 3, async_save: bool = True):
+        if ocp is None:
+            raise ImportError(
+                "ShardedCheckpointer requires orbax-checkpoint, which "
+                "failed to import in this environment: "
+                f"{_ORBAX_IMPORT_ERROR!r}")
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         opts = ocp.CheckpointManagerOptions(
@@ -37,6 +55,9 @@ class ShardedCheckpointer:
 
     def save(self, step: int, state: Any, metrics: Optional[dict] = None,
              force: bool = False):
+        # chaos site: simulated shard-write failure for THIS step label
+        _faults.maybe_fail("checkpoint_fail", int(step))
+        _SAVES.inc()
         self._mgr.save(int(step), args=ocp.args.StandardSave(state),
                        metrics=metrics, force=force)
 
@@ -86,20 +107,48 @@ class CheckpointListener(TrainingListener):
         hook = getattr(model, "_param_sync_hook", None)
         if hook is not None:   # lazily-synced trainer-owned params
             hook()
-        return {"params": model.params_tree,
-                "opt_state": model.opt_state,
-                "model_state": model.state_tree,
-                "counters": {"iteration": it,
-                             "epoch": model.epoch_count}}
+        state = {"params": model.params_tree,
+                 "opt_state": model.opt_state,
+                 "model_state": model.state_tree,
+                 "counters": {"iteration": it,
+                              "epoch": model.epoch_count,
+                              # completed batches within the current
+                              # epoch: run_fit fast-forwards the
+                              # iterator past exactly this many on
+                              # resume, so the continuation replays
+                              # nothing and skips nothing
+                              "batch_in_epoch": int(getattr(
+                                  model, "batch_in_epoch", 0))}}
+        rng = getattr(model, "_rng", None)
+        if rng is not None:
+            # the key STREAM position, so resumed dropout masks etc.
+            # match the uninterrupted run's draw-for-draw
+            state["rng"] = rng.state()
+        return state
+
+    def _try_save(self, step: int, state, metrics=None, force=False):
+        """Periodic saves are best-effort: a failed write (full disk,
+        flaky GCS, injected chaos) must not kill a healthy training
+        run — it costs recovery granularity, which is exactly what
+        ``checkpoint_failures_total`` alarms on.  Returns True when
+        the save was initiated."""
+        try:
+            self.ckpt.save(step, state, metrics=metrics, force=force)
+            return True
+        except Exception:
+            _FAILURES.inc()
+            log.exception("checkpoint save at step %d failed; training "
+                          "continues (previous checkpoints intact)", step)
+            return False
 
     def iteration_done(self, model, iteration, epoch, loss):
         if self.every_iter and iteration > 0 and \
                 iteration % self.every_iter == 0:
             # orbax step label = the iteration the checkpoint was taken
             # at; the stored counter = iteration + 1 (completed).
-            self.ckpt.save(iteration, self._state(model, iteration + 1),
-                           metrics={"loss": float(loss)})
-            self._last_saved_step = iteration
+            if self._try_save(iteration, self._state(model, iteration + 1),
+                              metrics={"loss": float(loss)}):
+                self._last_saved_step = iteration
 
     def on_epoch_end(self, model, epoch):
         if self.every_epoch and (epoch + 1) % self.every_epoch == 0 \
@@ -115,25 +164,65 @@ class CheckpointListener(TrainingListener):
             # empty, but the orbax directory isn't).
             if step == self._last_saved_step or step in self.ckpt.all_steps():
                 return
-            self.ckpt.save(step, self._state(model))
-            self._last_saved_step = step
+            if self._try_save(step, self._state(model)):
+                self._last_saved_step = step
 
-    def restore_into(self, model):
-        """Resume a model in place from the newest checkpoint; returns the
-        restored step or None."""
-        step, state = self.ckpt.restore_latest(self._state(model))
-        if step is None:
-            return None
+    @staticmethod
+    def _apply_trees(model, state):
+        """Overwrite the model's params/opt/model-state trees from a
+        restored checkpoint, disarming any deferred pipeline unstack
+        (hook protocol defined in parallel/trainer.py) so it cannot
+        clobber the restored weights."""
         model.params_tree = state["params"]
         model.opt_state = state["opt_state"]
         model.state_tree = state["model_state"]
-        model.iteration_count = int(state["counters"]["iteration"])
-        model.epoch_count = int(state["counters"]["epoch"])
-        # a lazily-synced trainer must not clobber the restored tree
-        # with a deferred unstack of PRE-restore training state (hook
-        # protocol defined in parallel/trainer.py)
         discard = getattr(getattr(model, "_param_sync_hook", None),
                           "discard_pending", None)
         if discard is not None:
             discard()
+
+    def restore_params_into(self, model):
+        """Restore ONLY the parameter/optimizer/model-state trees from
+        the newest checkpoint, leaving counters, batch position, and
+        the RNG stream at their CURRENT values — the rollback
+        primitive: after a divergence, training resumes from the last
+        good weights but keeps moving FORWARD through the data stream
+        (rewinding the live iterator is impossible in general, and
+        rewinding the counters without it would desynchronize every
+        later checkpoint's resume bookkeeping and collide orbax step
+        labels).  Returns the restored step or None."""
+        step, state = self.ckpt.restore_latest(self._state(model))
+        if step is None:
+            return None
+        self._apply_trees(model, state)
+        return step
+
+    def restore_into(self, model):
+        """Resume a model in place from the newest checkpoint; returns the
+        restored step or None."""
+        like = self._state(model)
+        try:
+            step, state = self.ckpt.restore_latest(like)
+        except Exception:
+            # checkpoints written before the resilience layer lack the
+            # rng leaf / batch_in_epoch counter; retry with the legacy
+            # template so old runs stay resumable (counters fall back
+            # to epoch-start, rng to the fresh stream)
+            legacy = {k: v for k, v in like.items() if k != "rng"}
+            legacy["counters"] = {
+                k: v for k, v in like["counters"].items()
+                if k != "batch_in_epoch"}
+            step, state = self.ckpt.restore_latest(legacy)
+            log.warning("restored a pre-resilience checkpoint (step %s):"
+                        " no rng/batch position — resume is epoch-"
+                        "aligned, not batch-exact", step)
+        if step is None:
+            return None
+        self._apply_trees(model, state)
+        model.iteration_count = int(state["counters"]["iteration"])
+        model.epoch_count = int(state["counters"]["epoch"])
+        model.batch_in_epoch = int(
+            state["counters"].get("batch_in_epoch", 0))
+        if "rng" in state and getattr(model, "_rng", None) is not None:
+            model._rng.set_state(state["rng"])
         return step

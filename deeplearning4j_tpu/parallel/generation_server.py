@@ -27,6 +27,22 @@ Design:
 * finished slots (budget exhausted or EOS sampled) retire back to
   their callers and free up for the next queued request.
 
+Self-healing (resilience layer): the scheduler's in-flight state
+(active slots, wait line, free list) lives on the INSTANCE under a
+lock, and the scheduler thread holds an epoch token — so a watchdog
+thread can declare a tick stuck (``tick_timeout_s`` exceeded) or the
+scheduler dead, fail the in-flight requests with a typed
+``RetryableServerError``, rebuild the slot pool, bump the epoch (the
+old thread, if it ever wakes, sees the stale token and exits without
+touching anything), and start a fresh scheduler — admission resumes
+instead of the server dying with its callers blocked forever.
+Requests carry optional deadlines (queue wait counts), handles can be
+``cancel()``-ed to release their queue entry/slot budget, blocking
+``submit()`` optionally retries retryable failures with jittered
+exponential backoff, and ``shutdown(drain=True)`` finishes in-flight
+work before exiting.  ``server_healthy`` /
+``serve_watchdog_restarts_total`` expose the recovery loop to scrapes.
+
 Greedy decode through the server is byte-identical to offline
 ``TransformerGenerator.generate()`` per request — the tick runs the
 same stacked-params layer scan.  Sampling (``temperature``/``top_k``/
@@ -40,6 +56,8 @@ request), speculative decode, and per-request sampling params.
 """
 from __future__ import annotations
 
+import itertools
+import logging
 import queue
 import threading
 import time
@@ -53,6 +71,13 @@ from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.models.generation import (TransformerGenerator,
                                                   _filter_logits)
 from deeplearning4j_tpu.parallel.inference import _bucket
+from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience.errors import (CancelledError,
+                                                  DeadlineExceededError,
+                                                  RetryableServerError)
+from deeplearning4j_tpu.resilience.retry import retry_call
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 # Serving-decode telemetry (the serve-side counterpart of the
 # parallel.inference series): slot occupancy answers "is the decode
@@ -83,6 +108,28 @@ _RATE = telemetry.histogram(
     "generation_server_request_tokens_per_sec",
     "per-request generated tokens / residence seconds",
     buckets=(1., 4., 16., 64., 256., 1024., 4096., 16384.))
+# Self-healing series: a load balancer drains on server_healthy == 0;
+# watchdog restarts at any steady rate are an incident, not noise.
+_HEALTHY = telemetry.gauge(
+    "server_healthy",
+    "1 while the decode scheduler is alive and admitting; 0 during "
+    "watchdog recovery and after shutdown (one child per server "
+    "instance — a process can run several)", labelnames=("server",))
+_SERVER_SEQ = itertools.count()
+_WATCHDOG_RESTARTS = telemetry.counter(
+    "serve_watchdog_restarts_total",
+    "scheduler restarts forced by the watchdog (stuck tick or dead "
+    "scheduler thread)")
+_TICK_FAILURES = telemetry.counter(
+    "generation_server_tick_failures_total",
+    "decode/prefill dispatch failures absorbed by the inline "
+    "rebuild path")
+_DEADLINE_EXCEEDED = telemetry.counter(
+    "generation_server_deadline_exceeded_total",
+    "requests failed because their deadline elapsed (queue + decode)")
+_CANCELLED = telemetry.counter(
+    "generation_server_cancelled_total",
+    "requests released via handle.cancel() before completion")
 
 
 class _Pending:
@@ -91,14 +138,18 @@ class _Pending:
     ``ttft`` (seconds) is populated when the first token lands."""
 
     __slots__ = ("prompt", "n_new", "eos_id", "seed", "t_submit",
-                 "t0", "emitted", "ttft", "_result", "_error", "_event")
+                 "deadline", "cancelled", "t0", "emitted", "ttft",
+                 "_result", "_error", "_event")
 
-    def __init__(self, prompt, n_new, eos_id, seed):
+    def __init__(self, prompt, n_new, eos_id, seed,
+                 deadline: Optional[float] = None):
         self.prompt = prompt
         self.n_new = n_new
         self.eos_id = eos_id
         self.seed = seed
         self.t_submit = time.perf_counter()
+        self.deadline = deadline         # absolute time.monotonic(), or None
+        self.cancelled = False
         self.t0 = len(prompt)
         self.emitted = 0
         self.ttft = None
@@ -111,13 +162,28 @@ class _Pending:
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block until the request retires; returns the full sequence
-        [t0 + n_emitted] (prompt + generated, EOS included when hit)."""
+        [t0 + n_emitted] (prompt + generated, EOS included when hit).
+        A ``TimeoutError`` here leaves the request LIVE server-side —
+        call :meth:`cancel` to release its queue entry / slot budget
+        if the result is no longer wanted."""
         if not self._event.wait(timeout):
             raise TimeoutError(
-                f"generation result not ready within {timeout}s")
+                f"generation result not ready within {timeout}s "
+                f"(the request is still live; cancel() releases it)")
         if self._error is not None:
             raise self._error
         return self._result
+
+    def cancel(self) -> bool:
+        """Best-effort cancellation: marks the request; the scheduler
+        releases its queue entry (if still waiting) or its slot (at
+        the next tick boundary) and ``result()`` raises
+        ``CancelledError``.  Returns False when the request already
+        completed — the existing result/error stands."""
+        if self._event.is_set():
+            return False
+        self.cancelled = True
+        return True
 
 
 class GenerationServer:
@@ -128,12 +194,19 @@ class GenerationServer:
     >>> out = srv.submit(prompt_ids, n_new=64)           # blocking
     >>> h = srv.submit_async(prompt_ids, n_new=64)       # handle
     >>> out = h.result(); h.ttft                         # seconds
-    >>> srv.shutdown()
+    >>> srv.shutdown(drain=True)                         # finish work
 
     ``temperature``/``top_k``/``top_p`` configure sampling for ALL
     requests (greedy by default — byte-identical to offline
     ``generate()``); ``eos_id`` per request stops decode early the tick
-    the token is emitted."""
+    the token is emitted.
+
+    Resilience knobs: ``tick_timeout_s`` arms the watchdog (None
+    disables it); ``request_deadline_s`` is the default per-request
+    deadline (``submit*``'s ``deadline_s`` overrides); blocking
+    ``submit`` retries ``RetryableServerError`` failures up to
+    ``submit_retries`` times with jittered exponential backoff from
+    ``retry_backoff_s``."""
 
     def __init__(self, net, n_slots: int = 8,
                  max_len: Optional[int] = None,
@@ -141,7 +214,11 @@ class GenerationServer:
                  temperature: float = 0.0,
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
-                 queue_limit: int = 1024):
+                 queue_limit: int = 1024,
+                 tick_timeout_s: Optional[float] = 30.0,
+                 request_deadline_s: Optional[float] = None,
+                 submit_retries: int = 0,
+                 retry_backoff_s: float = 0.05):
         self._gen = TransformerGenerator(net, compute_dtype=compute_dtype)
         gen = self._gen
         self.n_slots = int(n_slots)
@@ -162,6 +239,12 @@ class GenerationServer:
         self.temperature = float(temperature)
         self.top_k = top_k
         self.top_p = top_p
+        self.tick_timeout_s = (float(tick_timeout_s)
+                               if tick_timeout_s else None)
+        self.request_deadline_s = (float(request_deadline_s)
+                                   if request_deadline_s else None)
+        self.submit_retries = int(submit_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
 
         self._fresh_pool()
         self._ids = np.zeros((self.n_slots, self.max_len),
@@ -171,9 +254,36 @@ class GenerationServer:
         self._admit_cache = {}
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue(
             maxsize=queue_limit)
+        # Scheduler state shared with the watchdog: _active/_pending/
+        # _free mutate only under _lock; the epoch token fences a
+        # recovered-past scheduler thread out of every commit point.
+        self._lock = threading.RLock()
+        self._active = {}                # slot -> request
+        self._pending = []               # admitted-order wait line
+        self._free = list(range(self.n_slots - 1, -1, -1))
+        self._epoch = 0
+        self._tick_started = None        # (epoch, monotonic ts) while a
+                                         # dispatch is in flight
         self._shutdown = False
-        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._drain = False
+        self._stop_event = threading.Event()   # ends the watchdog
+        # retire prior DEAD servers' series before adding ours: the
+        # last-known 0 stays scrapeable until the next construction,
+        # but a long-lived process cycling servers does not leak
+        # unbounded label cardinality
+        for vals, child in _HEALTHY._items():
+            if child.value == 0:
+                _HEALTHY.remove(*vals)
+        self._healthy = _HEALTHY.labels(server=str(next(_SERVER_SEQ)))
+        self._worker = threading.Thread(target=self._run, args=(0,),
+                                        daemon=True)
         self._worker.start()
+        self._healthy.set(1)
+        self._watchdog = None
+        if self.tick_timeout_s:
+            self._watchdog = threading.Thread(target=self._watch,
+                                              daemon=True)
+            self._watchdog.start()
 
     def _fresh_pool(self):
         """(Re)allocate the KV caches and per-slot device state — every
@@ -217,12 +327,22 @@ class GenerationServer:
                                         cast(head_p))
         self._params = (emb_p, blk_stack, head_p)
 
+    def healthy(self) -> bool:
+        """True while the scheduler thread is alive and admission is
+        open (the ``server_healthy`` gauge, as a method)."""
+        return (not self._shutdown and self._worker.is_alive())
+
     def submit_async(self, prompt_ids, n_new: int,
                      eos_id: Optional[int] = None,
-                     seed: int = 0) -> _Pending:
+                     seed: int = 0,
+                     deadline_s: Optional[float] = None) -> _Pending:
         """Enqueue one sequence; returns a handle whose ``result()``
         blocks.  ``prompt_ids`` is a 1-D int array; the request decodes
-        until ``n_new`` tokens are emitted or ``eos_id`` is sampled."""
+        until ``n_new`` tokens are emitted or ``eos_id`` is sampled.
+        ``deadline_s`` (default: the server's ``request_deadline_s``)
+        bounds the request's total residence — queue wait included;
+        past it the request fails with ``DeadlineExceededError`` and
+        its slot is reclaimed."""
         if self._shutdown:
             raise RuntimeError("GenerationServer has been shut down")
         prompt = np.asarray(prompt_ids, np.int32)
@@ -236,8 +356,13 @@ class GenerationServer:
             raise ValueError(
                 f"prompt ({len(prompt)}) + n_new ({n_new}) exceeds the "
                 f"slot cache length ({self.max_len})")
+        deadline_s = (self.request_deadline_s if deadline_s is None
+                      else float(deadline_s))
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
         req = _Pending(prompt, n_new,
-                       -1 if eos_id is None else int(eos_id), int(seed))
+                       -1 if eos_id is None else int(eos_id), int(seed),
+                       deadline=deadline)
         while True:
             try:
                 self._queue.put(req, timeout=0.1)
@@ -255,10 +380,26 @@ class GenerationServer:
 
     def submit(self, prompt_ids, n_new: int,
                eos_id: Optional[int] = None, seed: int = 0,
-               timeout: Optional[float] = None) -> np.ndarray:
-        """Blocking ``submit_async().result()``."""
-        return self.submit_async(prompt_ids, n_new, eos_id,
-                                 seed).result(timeout)
+               timeout: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               retries: Optional[int] = None) -> np.ndarray:
+        """Blocking ``submit_async().result()``.  ``retries`` (default:
+        the server's ``submit_retries``) re-submits after a
+        ``RetryableServerError`` — a watchdog/tick-failure recovery
+        that failed this request through no fault of its own — with
+        full-jitter exponential backoff so a herd of failed callers
+        does not re-collide on the rebuilt pool."""
+        retries = self.submit_retries if retries is None else int(retries)
+
+        def attempt():
+            return self.submit_async(prompt_ids, n_new, eos_id, seed,
+                                     deadline_s=deadline_s).result(timeout)
+
+        if retries <= 0:
+            return attempt()
+        return retry_call(attempt, retries=retries,
+                          base_delay=self.retry_backoff_s,
+                          op="generation_server.submit")
 
     def _fail_leftovers(self):
         """Drain and fail queued requests once the worker is gone —
@@ -274,16 +415,36 @@ class GenerationServer:
             if item is not None:
                 self._retire(item, -1, error=err)
 
-    def shutdown(self):
-        """Stop the scheduler.  In-flight and queued requests fail with
-        RuntimeError — collect results before shutting down."""
-        self._shutdown = True
+    def shutdown(self, drain: bool = False, timeout: float = 30.0):
+        """Stop the scheduler.  Default: in-flight and queued requests
+        fail immediately with RuntimeError (collect results first).
+        ``drain=True``: admission closes (new submits raise) but
+        everything already submitted runs to completion before the
+        scheduler exits — the rolling-restart mode.  ``timeout`` bounds
+        the wait for the scheduler thread either way."""
+        with self._lock:
+            self._drain = bool(drain)
+            self._shutdown = True
+            worker = self._worker
         self._queue.put(None)
-        self._worker.join(timeout=30)
+        worker.join(timeout=timeout)
+        if worker.is_alive():
+            log.warning("GenerationServer scheduler did not exit within "
+                        "%.3gs (drain=%s); abandoning it and failing "
+                        "its in-flight requests", timeout, drain)
+            with self._lock:
+                self._epoch += 1     # fence the hung scheduler out
+            self._fail_all_in_flight(RuntimeError(
+                "GenerationServer shut down while the scheduler was "
+                "unresponsive; the request was abandoned in flight"))
+        self._stop_event.set()           # watchdog stands down
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5)
         # a submit that passed the _shutdown check concurrently may
         # have enqueued AFTER the sentinel (the worker exits on the
         # first None it sees)
         self._fail_leftovers()
+        self._healthy.set(0)
 
     def __enter__(self):
         return self
@@ -376,18 +537,26 @@ class GenerationServer:
         return fn
 
     # -- scheduler -----------------------------------------------------
-    def _admit(self, req: _Pending, slot: int):
+    def _admit(self, req: _Pending, slot: int, my_epoch: int) -> bool:
+        """Prefill dispatch + commit; returns False when a watchdog
+        recovery superseded this scheduler mid-admission (the caller
+        must exit without touching shared state)."""
         tb = _bucket(req.t0, self.max_len)
         padded = np.zeros((1, tb), np.int32)
         padded[0, :req.t0] = req.prompt
         emb_p, blk_stack, head_p = self._params
-        self._kc, self._vc, self._state = self._admit_fn(tb)(
+        out = self._admit_fn(tb)(
             emb_p, blk_stack, head_p, self._kc, self._vc, self._state,
             jnp.asarray(padded), np.int32(req.t0), np.int32(slot),
             np.int32(req.n_new), np.int32(req.eos_id),
             jax.random.PRNGKey(req.seed))
+        with self._lock:
+            if self._epoch != my_epoch:
+                return False
+            self._kc, self._vc, self._state = out
         self._ids[slot, :req.t0] = req.prompt
         _ADMITTED.inc()
+        return True
 
     def _retire(self, req: _Pending, slot: int, error=None):
         if error is not None:
@@ -400,91 +569,259 @@ class GenerationServer:
         _RETIRED.inc()
         req._event.set()
 
-    def _run(self):
+    def _reap_pending_locked(self, now: float):
+        """Drop cancelled / deadline-expired requests from the wait
+        line (caller holds the lock); returns the victims to retire
+        outside it."""
+        keep, victims = [], []
+        for req in self._pending:
+            if req.cancelled:
+                victims.append((req, "cancel"))
+            elif req.deadline is not None and now > req.deadline:
+                victims.append((req, "deadline"))
+            else:
+                keep.append(req)
+        self._pending = keep
+        return victims
+
+    def _retire_reaped(self, victims):
+        for req, why in victims:
+            if why == "cancel":
+                _CANCELLED.inc()
+                self._retire(req, -1, error=CancelledError(
+                    "generation request cancelled"))
+            else:
+                _DEADLINE_EXCEEDED.inc()
+                self._retire(req, -1, error=DeadlineExceededError(
+                    "generation request deadline elapsed before "
+                    "completion"))
+
+    def _mark_tick(self, my_epoch: int, value) -> None:
+        """Set/clear the in-flight dispatch timestamp, but only while
+        this scheduler still owns the epoch — a superseded thread must
+        not clobber the live scheduler's stuck-tick timer."""
+        with self._lock:
+            if self._epoch == my_epoch:
+                self._tick_started = value
+
+    def _fail_all_in_flight(self, err) -> None:
+        """Clear active + pending under the lock and fail every caller;
+        the slot pool/free list resets to empty."""
+        with self._lock:
+            victims = list(self._active.values()) + list(self._pending)
+            self._active.clear()
+            self._pending = []
+            self._free = list(range(self.n_slots - 1, -1, -1))
+        for req in victims:
+            self._retire(req, -1, error=err)
+        _SLOTS_BUSY.set(0)
+        _QDEPTH.set(self._queue.qsize())
+
+    def _run(self, my_epoch: int):
         tracer = telemetry.get_tracer()
-        pending = []             # admitted-order wait line (host side)
-        active = {}              # slot -> request
-        free = list(range(self.n_slots - 1, -1, -1))
         stop = False
         while True:
+            with self._lock:
+                if self._epoch != my_epoch:
+                    return
+                idle = not self._active and not self._pending
             # ingest: block only when idle, else drain without waiting
-            if not active and not pending:
+            if idle and not stop:
                 item = self._queue.get()
+                if self._epoch != my_epoch:
+                    # recovered past us while we slept: hand the item
+                    # to the live scheduler (sentinels included)
+                    self._queue.put(item)
+                    return
                 if item is None:
                     stop = True
                 else:
-                    pending.append(item)
-            while not stop:
-                try:
+                    with self._lock:
+                        self._pending.append(item)
+            while True:          # opportunistic drain (also ingests
+                try:             # requests raced in behind a sentinel)
                     item = self._queue.get_nowait()
                 except queue.Empty:
                     break
+                if self._epoch != my_epoch:
+                    self._queue.put(item)
+                    return
                 if item is None:
                     stop = True
                 else:
-                    pending.append(item)
-            if stop:
-                err = RuntimeError("GenerationServer shut down with the "
-                                   "request in flight")
-                while True:      # requests enqueued behind the sentinel
-                    try:
-                        item = self._queue.get_nowait()
-                    except queue.Empty:
-                        break
-                    if item is not None:
-                        pending.append(item)
-                for slot, req in active.items():
-                    self._retire(req, slot, error=err)
-                for req in pending:
-                    self._retire(req, -1, error=err)
-                _SLOTS_BUSY.set(0)
+                    with self._lock:
+                        self._pending.append(item)
+            # chaos site (post-ingest, pre-dispatch, OUTSIDE the inline
+            # try): an exception here escapes the scheduler thread
+            # entirely — the watchdog must notice the corpse, fail the
+            # in-flight requests and restart the scheduler
+            _faults.maybe_fail("serve_tick_fail")
+            if stop and not self._drain:
+                self._fail_all_in_flight(
+                    RuntimeError("GenerationServer shut down with the "
+                                 "request in flight"))
                 _QDEPTH.set(0)
                 return
+            if stop:             # drain mode: exit once everything ran
+                with self._lock:
+                    done = not self._active and not self._pending
+                if done and self._queue.empty():
+                    _SLOTS_BUSY.set(0)
+                    _QDEPTH.set(0)
+                    return
             try:
-                while free and pending:
-                    req = pending.pop(0)
-                    slot = free.pop()
-                    self._admit(req, slot)
-                    active[slot] = req
-                _QDEPTH.set(len(pending) + self._queue.qsize())
-                _SLOTS_BUSY.set(len(active))
-                if not active:
+                now = time.monotonic()
+                with self._lock:
+                    if self._epoch != my_epoch:
+                        return
+                    reaped = self._reap_pending_locked(now)
+                    admits = []
+                    while self._free and self._pending:
+                        req = self._pending.pop(0)
+                        slot = self._free.pop()
+                        # active BEFORE the prefill dispatch: if the
+                        # watchdog takes over mid-admission the request
+                        # must be in the set it fails over
+                        self._active[slot] = req
+                        admits.append((req, slot))
+                    n_pending = len(self._pending)
+                    n_active = len(self._active)
+                self._retire_reaped(reaped)
+                for req, slot in admits:
+                    self._mark_tick(my_epoch, (my_epoch, time.monotonic()))
+                    committed = self._admit(req, slot, my_epoch)
+                    self._mark_tick(my_epoch, None)
+                    if not committed:
+                        return
+                _QDEPTH.set(n_pending + self._queue.qsize())
+                _SLOTS_BUSY.set(n_active)
+                if not n_active:
                     continue
                 emb_p, blk_stack, head_p = self._params
-                with tracer.span("serve/tick", active=len(active),
-                                 queued=len(pending)):
-                    self._kc, self._vc, self._state, tok = self._tick(
+                with tracer.span("serve/tick", active=n_active,
+                                 queued=n_pending):
+                    self._mark_tick(my_epoch, (my_epoch, time.monotonic()))
+                    # chaos site: a hung dispatch — the host blocks in
+                    # here past tick_timeout_s and the watchdog takes
+                    # over; on wake the epoch check fences us out
+                    _faults.maybe_stall("serve_tick_stall")
+                    with self._lock:
+                        if self._epoch != my_epoch:
+                            return
+                    kc, vc, state, tok = self._tick(
                         emb_p, blk_stack, head_p, self._kc, self._vc,
                         self._state)
                     tok_h = np.asarray(tok)
-                    rem_h = np.asarray(self._state["remaining"])
+                    rem_h = np.asarray(state["remaining"])
+                    self._mark_tick(my_epoch, None)
                 _TICKS.inc()
-                _OCC.observe(len(active) / self.n_slots)
-                now = time.perf_counter()
-                for slot in list(active):
-                    req = active[slot]
-                    self._ids[slot, req.t0 + req.emitted] = tok_h[slot]
-                    req.emitted += 1
-                    if req.ttft is None:
-                        req.ttft = now - req.t_submit
-                        _TTFT.observe(req.ttft)
-                    if rem_h[slot] == 0:
+                _OCC.observe(n_active / self.n_slots)
+                now_p = time.perf_counter()
+                now_m = time.monotonic()
+                finished = []
+                with self._lock:
+                    if self._epoch != my_epoch:
+                        return
+                    self._kc, self._vc, self._state = kc, vc, state
+                    for slot in list(self._active):
+                        req = self._active[slot]
+                        self._ids[slot, req.t0 + req.emitted] = tok_h[slot]
+                        req.emitted += 1
+                        if req.ttft is None:
+                            req.ttft = now_p - req.t_submit
+                            _TTFT.observe(req.ttft)
+                        done = rem_h[slot] == 0
+                        expired = (req.deadline is not None
+                                   and now_m > req.deadline)
+                        if done or req.cancelled or expired:
+                            del self._active[slot]
+                            self._free.append(slot)
+                            finished.append((req, slot, done))
+                    n_active = len(self._active)
+                    n_pending = len(self._pending)
+                for req, slot, done in finished:
+                    if done:
                         self._retire(req, slot)
-                        del active[slot]
-                        free.append(slot)
+                    elif req.cancelled:
+                        # the slot is freed host-side; device-side the
+                        # zombie row decodes out its (bounded) budget
+                        # harmlessly until the next admission rearms it
+                        _CANCELLED.inc()
+                        self._retire(req, slot, error=CancelledError(
+                            "generation request cancelled"))
+                    else:
+                        _DEADLINE_EXCEEDED.inc()
+                        self._retire(req, slot,
+                                     error=DeadlineExceededError(
+                                         "generation request deadline "
+                                         "elapsed mid-decode"))
                 # post-tick refresh so an idle pool scrapes as 0 busy
                 # (the loop blocks on the queue next, with no tick to
                 # update the gauges)
-                _SLOTS_BUSY.set(len(active))
-                _QDEPTH.set(len(pending) + self._queue.qsize())
+                _SLOTS_BUSY.set(n_active)
+                _QDEPTH.set(n_pending + self._queue.qsize())
             except Exception as e:  # surface to every blocked caller
-                for slot, req in active.items():
-                    self._retire(req, slot, error=e)
-                for req in pending:
-                    self._retire(req, -1, error=e)
-                active.clear()
-                pending.clear()
-                free = list(range(self.n_slots - 1, -1, -1))
+                self._mark_tick(my_epoch, None)
+                with self._lock:
+                    if self._epoch != my_epoch:
+                        return
+                _TICK_FAILURES.inc()
+                err = RetryableServerError(
+                    "decode dispatch failed and the slot pool was "
+                    "rebuilt; the request was not applied — safe to "
+                    "retry")
+                err.__cause__ = e
+                log.exception("GenerationServer tick/admit failed; "
+                              "rebuilding the slot pool")
+                self._fail_all_in_flight(err)
                 # the failed dispatch may have consumed the donated
                 # buffers mid-update: rebuild a clean inactive pool
                 self._fresh_pool()
+
+    # -- watchdog ------------------------------------------------------
+    def _watch(self):
+        """Detect a stuck dispatch (``tick_timeout_s`` exceeded) or a
+        dead scheduler thread, then fail in-flight work with a
+        retryable error, rebuild the pool and restart the scheduler —
+        graceful degradation instead of a dead server."""
+        interval = max(0.01, min(self.tick_timeout_s / 4.0, 0.5))
+        while True:
+            if self._stop_event.wait(interval):
+                return
+            with self._lock:
+                if self._shutdown:   # shutdown owns the thread now
+                    return
+                worker = self._worker
+                started = self._tick_started
+                epoch = self._epoch
+            stuck = (started is not None and started[0] == epoch and
+                     time.monotonic() - started[1] > self.tick_timeout_s)
+            if stuck:
+                self._recover(f"dispatch exceeded tick_timeout_s="
+                              f"{self.tick_timeout_s:g}")
+            elif not worker.is_alive():
+                self._recover("scheduler thread died")
+
+    def _recover(self, reason: str):
+        with self._lock:
+            if self._stop_event.is_set() or self._shutdown:
+                return
+            self._epoch += 1     # fences the old scheduler out of
+            new_epoch = self._epoch  # every commit point
+            self._tick_started = None
+            self._healthy.set(0)
+        _WATCHDOG_RESTARTS.inc()
+        log.warning("GenerationServer watchdog: %s — failing in-flight "
+                    "requests and restarting the scheduler", reason)
+        self._fail_all_in_flight(RetryableServerError(
+            f"decode scheduler recovered ({reason}); the request "
+            f"failed in flight and was not applied — safe to retry"))
+        self._fresh_pool()
+        with self._lock:
+            if self._stop_event.is_set() or self._shutdown:
+                return
+            self._worker = threading.Thread(target=self._run,
+                                            args=(new_epoch,),
+                                            daemon=True)
+            self._worker.start()
+            self._healthy.set(1)

@@ -300,13 +300,22 @@ class ShardedTrainer:
                 params=params["post"][last])
             return jnp.mean(scores) + self._pipe_reg(params)
 
-        def step(params, opt_state, it, batch):
+        from deeplearning4j_tpu.optimize.solver import (
+            apply_updates_if, finite_step_ok, select_step)
+
+        def step(params, opt_state, it, batch, lr_scale):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            # same bad-step guard as Solver._step_impl (shared
+            # helpers): a non-finite loss/grad step must not move
+            # params or optimizer state, while the NaN loss still
+            # reaches the host-side policy
+            ok = finite_step_ok(loss, grads)
+            old_opt_state = opt_state
             updates, opt_state = self._updater.update(
                 grads, opt_state, params, it)
-            params = jax.tree_util.tree_map(
-                lambda p, u: p - u, params, updates)
+            params = apply_updates_if(ok, params, updates, lr_scale)
             opt_state = self._updater.finalize(opt_state, params)
+            opt_state = select_step(ok, opt_state, old_opt_state)
             return params, opt_state, loss
 
         self._pipe_step = jax.jit(step, donate_argnums=(0, 1))
@@ -411,7 +420,8 @@ class ShardedTrainer:
                              mesh=str(dict(self.mesh.shape))), self.mesh:
                 (self._pipe_params, self._pipe_opt, loss) = \
                     self._pipe_step(self._pipe_params, self._pipe_opt,
-                                    m.iteration_count, batch)
+                                    m.iteration_count, batch,
+                                    float(getattr(m, "_lr_backoff", 1.0)))
             self._model_stale = True
             self._step_counter.inc()   # dispatched, not failed validation
             return loss
@@ -420,7 +430,8 @@ class ShardedTrainer:
                          mesh=str(dict(self.mesh.shape))), self.mesh:
             (m.params_tree, m.opt_state, m.state_tree, loss) = \
                 self.solver.step(m.params_tree, m.opt_state, m.state_tree,
-                                 m.iteration_count, batch, m._rng.next_key())
+                                 m.iteration_count, batch, m._rng.next_key(),
+                                 lr_scale=getattr(m, "_lr_backoff", 1.0))
         self._step_counter.inc()
         return loss
 
@@ -445,10 +456,21 @@ class ShardedTrainer:
         self.model.iteration_count += 1
         return loss
 
-    def fit(self, iterator, n_epochs: int = 1):
+    def fit(self, iterator, n_epochs: int = 1, resume: bool = False):
         """Drive an iterator through the sharded step — the same shared
         epoch loop as MultiLayerNetwork/ComputationGraph.fit, so tBPTT,
-        MultiDataSet batches, listener ordering and counters agree."""
-        out = run_fit(self.model, iterator, n_epochs, self._step_dict)
+        MultiDataSet batches, listener ordering and counters agree.
+
+        ``resume=True`` restores the newest checkpoint from the
+        attached ``CheckpointListener`` before training (run_fit
+        semantics: ``n_epochs`` is then the TOTAL target) — the
+        preemption-recovery entry for sharded training."""
+        if resume and self._pipe is not None:
+            raise NotImplementedError(
+                "resume is not wired for the pipeline path yet: the "
+                "restored model tree must be restacked into the "
+                "pipe-sharded params (ROADMAP open item)")
+        out = run_fit(self.model, iterator, n_epochs, self._step_dict,
+                      resume=resume)
         self.sync_model()
         return out

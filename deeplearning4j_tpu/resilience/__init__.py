@@ -1,0 +1,47 @@
+"""Fault tolerance: deterministic chaos, preemption-safe training, and
+self-healing serving.
+
+The north star is production serving of heavy traffic; at that scale
+faults are workload, not anomaly — the TPU-supercomputer retrospective
+(PAPERS: "Training Supercomputers from TPU v2 to Ironwood") makes
+checkpoint-restart resilience an architectural property, and the
+TensorFlow paper treats periodic-checkpoint + replay as core
+infrastructure.  This package supplies the three pieces the rest of
+the tree wires in:
+
+* ``faults``     — a deterministic, seed-driven :class:`FaultInjector`
+  consulted at fixed sites in the fit loop, the checkpointer and the
+  decode scheduler (chaos CI: ``scripts/chaos_smoke.py``);
+* ``preemption`` + ``policy`` — SIGTERM-to-checkpoint handling,
+  ``auto_resume_fit`` restart supervision, and :class:`BadStepPolicy`
+  (skip / LR-backoff / rollback on NaN loss) over the solver's
+  skip-non-finite-update guarantee;
+* ``retry`` + ``errors`` — the typed failure vocabulary and the
+  jittered bounded-retry helper serving uses for submit retries.
+
+Every recovery event lands in the PR-1 telemetry registry:
+``faults_injected_total{kind=}``, ``train_{preemptions,resumes}_total``,
+``bad_steps_{skipped,rolled_back}_total``,
+``serve_watchdog_restarts_total``, ``server_healthy``,
+``retry_{attempts,backoff_seconds}{op=}``.
+"""
+from deeplearning4j_tpu.resilience.errors import (
+    CancelledError, DeadlineExceededError, InjectedFault,
+    RetryableServerError, TrainingPreempted)
+from deeplearning4j_tpu.resilience.faults import (
+    FAULT_KINDS, FaultInjector, FaultSpec)
+from deeplearning4j_tpu.resilience.policy import BadStepPolicy
+from deeplearning4j_tpu.resilience.preemption import (
+    PreemptionGuard, auto_resume_fit, clear_preemption,
+    preemption_requested, request_preemption)
+from deeplearning4j_tpu.resilience.retry import backoff_delay, retry_call
+
+__all__ = [
+    "FAULT_KINDS", "FaultInjector", "FaultSpec",
+    "InjectedFault", "TrainingPreempted", "RetryableServerError",
+    "DeadlineExceededError", "CancelledError",
+    "BadStepPolicy",
+    "PreemptionGuard", "auto_resume_fit", "request_preemption",
+    "preemption_requested", "clear_preemption",
+    "retry_call", "backoff_delay",
+]

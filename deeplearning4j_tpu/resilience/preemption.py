@@ -1,0 +1,123 @@
+"""Preemption-safe training: cooperative SIGTERM/SIGINT handling and
+the checkpoint-restart supervisor.
+
+TPU VMs are preemptible: the cluster manager sends SIGTERM and gives
+the process a grace window.  The wrong response is saving from inside
+the signal handler (async-signal context, arbitrary reentrancy); the
+right one is a FLAG the training loop polls at iteration boundaries —
+``run_fit`` then forces one final ``ShardedCheckpointer.save`` +
+``wait()`` and unwinds with :class:`TrainingPreempted`, so the grace
+window is spent writing shards, not finishing the epoch.
+
+``auto_resume_fit`` is the in-process supervisor: it re-enters a
+resumable fit (``resume=True``) after preemptions and transient step
+failures, bounded by ``max_restarts`` — the single-process analogue of
+the checkpoint-restart elasticity SURVEY.md §5.3 describes.
+"""
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Callable, Tuple, Type
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.resilience.errors import TrainingPreempted
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+PREEMPTIONS = telemetry.counter(
+    "train_preemptions_total",
+    "SIGTERM/SIGINT (or simulated) preemptions observed by run_fit")
+RESUMES = telemetry.counter(
+    "train_resumes_total",
+    "training runs that restored state from a checkpoint on entry")
+
+_FLAG = threading.Event()
+
+
+def request_preemption(signum=None, frame=None) -> None:
+    """Set the preemption flag — the signal handler body, also called
+    directly by the fault injector's simulated SIGTERM."""
+    if signum is not None:
+        log.warning("preemption signal %s received; training will "
+                    "checkpoint and exit at the next step boundary",
+                    signum)
+    _FLAG.set()
+
+
+def preemption_requested() -> bool:
+    return _FLAG.is_set()
+
+
+def clear_preemption() -> None:
+    _FLAG.clear()
+
+
+class PreemptionGuard:
+    """Scoped SIGTERM/SIGINT -> preemption-flag installation.
+
+    >>> with PreemptionGuard():
+    ...     model.fit(it, n_epochs=10)   # SIGTERM => checkpoint + raise
+
+    Restores the previous handlers on exit.  Signal handlers can only
+    be installed from the main thread; elsewhere the guard degrades to
+    a no-op with a warning (the flag API still works — a supervisor
+    thread may call ``request_preemption`` directly)."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self._previous = {}
+
+    def __enter__(self):
+        for s in self.signals:
+            try:
+                self._previous[s] = signal.signal(s, request_preemption)
+            except ValueError:                 # not the main thread
+                log.warning("PreemptionGuard: cannot install handler "
+                            "for %s off the main thread", s)
+        return self
+
+    def __exit__(self, *exc):
+        for s, prev in self._previous.items():
+            signal.signal(s, prev)
+        self._previous.clear()
+        return False
+
+
+def auto_resume_fit(fit_fn: Callable, max_restarts: int = 3,
+                    retry_on: Tuple[Type[BaseException], ...] = ()):
+    """Run ``fit_fn`` (a zero-arg callable driving a RESUMABLE fit,
+    i.e. one that passes ``resume=True`` with a ``CheckpointListener``
+    attached) to completion across preemptions.
+
+    ``TrainingPreempted`` always restarts (that is the point);
+    ``retry_on`` extends restart to transient step failures (e.g.
+    ``InjectedFault`` in chaos runs, or an infra error type).  Each
+    restart re-enters ``fit_fn``, whose ``resume=True`` path restores
+    the newest checkpoint and fast-forwards the iterator.  After
+    ``max_restarts`` unsuccessful re-entries the last error propagates.
+
+    >>> lst = CheckpointListener(dir, save_every_n_iterations=50)
+    >>> model.set_listeners(lst)
+    >>> auto_resume_fit(lambda: model.fit(it, n_epochs=10, resume=True))
+    """
+    restarts = 0
+    while True:
+        try:
+            return fit_fn()
+        except TrainingPreempted as e:
+            clear_preemption()
+            restarts += 1
+            if restarts > max_restarts:
+                raise
+            log.warning("preempted at checkpoint step %s; restart "
+                        "%d/%d resumes from it", e.step, restarts,
+                        max_restarts)
+        except retry_on as e:              # pragma: no branch
+            restarts += 1
+            if restarts > max_restarts:
+                raise
+            log.warning("training failed (%s: %s); restart %d/%d "
+                        "resumes from the last checkpoint",
+                        type(e).__name__, e, restarts, max_restarts)

@@ -42,3 +42,16 @@ class RngKeyManager:
         with self._lock:
             self._key = jax.random.key(seed)
             self.seed = seed
+
+    def state(self):
+        """The raw key data (uint32 array) — checkpointable.  A resumed
+        run that restores this replays the exact key stream the
+        uninterrupted run would have consumed (dropout masks included),
+        which is what makes kill-and-resume bit-identical."""
+        with self._lock:
+            return jax.random.key_data(self._key)
+
+    def set_state(self, data) -> None:
+        with self._lock:
+            self._key = jax.random.wrap_key_data(
+                jax.numpy.asarray(data, jax.numpy.uint32))

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Chaos smoke — the resilience-layer CI gate.
+
+Fires every :data:`deeplearning4j_tpu.resilience.FAULT_KINDS` injector
+kind exactly once against a real (tiny, CPU-sized) training run and a
+real ``GenerationServer``, then asserts:
+
+* training still completes with the uninterrupted run's EXACT final
+  loss and parameters (kill-and-resume is bit-identical; NaN steps are
+  skipped; a failed checkpoint write degrades, not kills);
+* the decode server survives a scheduler crash AND a hung tick via the
+  watchdog, and a retried submit returns offline-identical greedy
+  output;
+* every recovery event landed in the telemetry registry
+  (``faults_injected_total{kind=...}`` for each kind, resume/preempt/
+  bad-step/watchdog counters, submit retry histograms) — checked over
+  a real HTTP scrape via the helpers in ``check_telemetry.py``.
+
+Runs on CPU inside the tier-1 budget — wired into
+``tests/test_resilience.py::test_chaos_smoke`` un-marked, and runnable
+standalone:
+
+    JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+"""
+import importlib.util
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+# each training-side kind once, at deterministic iterations of a
+# 3-epoch x 6-batch run (18 iterations; checkpoints every 2)
+TRAIN_PLAN = ["data_stall@1:0.05", "nan_loss@3", "checkpoint_fail@4",
+              "step_exception@7", "preempt@12"]
+
+
+def _load_check_telemetry():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "check_telemetry.py")
+    spec = importlib.util.spec_from_file_location("check_telemetry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration, resilience,
+                                    telemetry)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.models.generation import TransformerGenerator
+    from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                        OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    from deeplearning4j_tpu.parallel import (CheckpointListener,
+                                             GenerationServer)
+    from deeplearning4j_tpu.resilience import (BadStepPolicy,
+                                               FaultInjector,
+                                               InjectedFault,
+                                               auto_resume_fit)
+    from deeplearning4j_tpu.zoo.gpt import Gpt
+
+    ct = _load_check_telemetry()
+    registry = telemetry.get_registry()
+    problems = []
+
+    def counter(name):
+        return registry.counter(name)
+
+    fault_counter = registry.counter("faults_injected_total",
+                                     labelnames=("kind",))
+
+    def model():
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Adam(learning_rate=1e-2)).list()
+                .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(96, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 96)]
+
+    def data():
+        return ListDataSetIterator(DataSet(x, y).batch_by(16))
+
+    # -- uninterrupted reference ---------------------------------------
+    ref = model()
+    ref_loss = ref.fit(data(), n_epochs=3, async_prefetch=False)
+
+    # -- training fault matrix -----------------------------------------
+    faults_before = {k: fault_counter.labels(kind=k).value
+                     for k in resilience.FAULT_KINDS}
+    resumes0 = counter("train_resumes_total").value
+    preempts0 = counter("train_preemptions_total").value
+    skipped0 = counter("bad_steps_skipped_total").value
+    ckfail0 = counter("checkpoint_failures_total").value
+
+    m = model()
+    with tempfile.TemporaryDirectory() as d:
+        ck = CheckpointListener(os.path.join(d, "ck"),
+                                save_every_n_iterations=2)
+        m.set_listeners(ck, BadStepPolicy(max_consecutive=3,
+                                          checkpoint=ck))
+        with FaultInjector(TRAIN_PLAN):
+            loss = auto_resume_fit(
+                lambda: m.fit(data(), n_epochs=3, async_prefetch=False,
+                              resume=True),
+                max_restarts=4, retry_on=(InjectedFault,))
+        ck.ckpt.close()
+    if m.epoch_count != 3:
+        problems.append(f"training finished {m.epoch_count}/3 epochs")
+    if loss is None or not np.isfinite(loss):
+        problems.append(f"post-chaos final loss {loss}")
+    if counter("train_resumes_total").value - resumes0 < 2:
+        problems.append("expected >= 2 checkpoint resumes "
+                        "(step_exception + preempt restarts)")
+    if counter("train_preemptions_total").value - preempts0 != 1:
+        problems.append("train_preemptions_total did not grow by 1")
+    if counter("bad_steps_skipped_total").value - skipped0 != 1:
+        problems.append("bad_steps_skipped_total did not grow by 1")
+    if counter("checkpoint_failures_total").value - ckfail0 != 1:
+        problems.append("checkpoint_failures_total did not grow by 1")
+
+    # -- preempt-only: kill-and-resume must be BIT-IDENTICAL -----------
+    # (the combined matrix above legitimately diverges from the
+    # reference: its NaN-poisoned update is skipped where the
+    # uninterrupted run applied the clean one)
+    m2 = model()
+    with tempfile.TemporaryDirectory() as d:
+        ck2 = CheckpointListener(os.path.join(d, "ck"),
+                                 save_every_n_iterations=5)
+        m2.set_listeners(ck2)
+        with FaultInjector(["preempt@8"]):
+            loss2 = auto_resume_fit(
+                lambda: m2.fit(data(), n_epochs=3, async_prefetch=False,
+                               resume=True), max_restarts=2)
+        ck2.ckpt.close()
+    if loss2 is None or float(loss2) != float(ref_loss):
+        problems.append(
+            f"preempt+resume final loss {loss2} != uninterrupted "
+            f"{ref_loss} (kill-and-resume not bit-identical)")
+
+    # -- serving fault matrix ------------------------------------------
+    wd0 = counter("serve_watchdog_restarts_total").value
+    gpt = Gpt(vocab_size=50, max_len=32, d_model=32, n_layers=2,
+              n_heads=4, d_ff=64, seq_len=8, compute_dtype=None,
+              seed=3).init_graph()
+    offline = TransformerGenerator(gpt)
+    p = np.asarray([1, 2, 3, 4], np.int32)
+    ref_out = offline.generate(p[None], n_new=6)[0]
+
+    # one server takes both hits in sequence: (1) a scheduler crash —
+    # the worker thread dies mid-service, the watchdog fails in-flight
+    # callers retryably and restarts admission; (2) a hung tick — the
+    # stall exceeds tick_timeout_s, the watchdog fences the stuck
+    # scheduler out; each time the blocking submit retries through
+    with GenerationServer(gpt, n_slots=2, max_len=32, tick_timeout_s=0.8,
+                          submit_retries=4, retry_backoff_s=0.02) as srv:
+        srv.submit(p, n_new=2, timeout=300)          # warm the compiles
+        with FaultInjector(["serve_tick_fail@0"]):
+            out = srv.submit(p, n_new=6, timeout=300)
+        if not np.array_equal(out, ref_out):
+            problems.append("post-crash-recovery output mismatch")
+        if not srv.healthy():
+            problems.append("server not healthy after crash recovery")
+        with FaultInjector(["serve_tick_stall@0:1.8"]):
+            out = srv.submit(p, n_new=6, timeout=300)
+        if not np.array_equal(out, ref_out):
+            problems.append("post-stall-recovery output mismatch")
+    if counter("serve_watchdog_restarts_total").value - wd0 < 2:
+        problems.append("expected >= 2 watchdog restarts (crash + stall)")
+
+    # -- every kind fired (preempt twice: matrix + bit-identical run) --
+    expected = {k: 1 for k in resilience.FAULT_KINDS}
+    expected["preempt"] = 2
+    for k in resilience.FAULT_KINDS:
+        delta = fault_counter.labels(kind=k).value - faults_before[k]
+        if delta != expected[k]:
+            problems.append(f"faults_injected_total{{kind={k}}} grew "
+                            f"{delta} != {expected[k]}")
+
+    # -- scrape: the recovery series are on the wire -------------------
+    body = ct.scrape_body(telemetry, registry)
+    required = list(ct.RESILIENCE_SERIES)
+    required += [f'faults_injected_total{{kind="{k}"}}'
+                 for k in resilience.FAULT_KINDS]
+    required += ["retry_attempts_bucket", "retry_backoff_seconds_bucket"]
+    problems += ct.missing_series(body, required)
+
+    print(json.dumps({"ok": not problems, "problems": problems}))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

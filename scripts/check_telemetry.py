@@ -31,6 +31,39 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import numpy as np
 
+# Resilience-layer series that exist in EVERY process that imports the
+# training/serving stack (unlabeled families expose at 0) — the plain
+# smoke asserts their presence; scripts/chaos_smoke.py additionally
+# asserts the labeled/event series after actually firing the faults.
+RESILIENCE_SERIES = [
+    "train_preemptions_total",
+    "train_resumes_total",
+    "bad_steps_skipped_total",
+    "bad_steps_rolled_back_total",
+    "train_lr_backoff_scale",
+    "checkpoint_saves_total",
+    "checkpoint_failures_total",
+    "server_healthy",
+    "serve_watchdog_restarts_total",
+    "generation_server_tick_failures_total",
+    "generation_server_deadline_exceeded_total",
+    "generation_server_cancelled_total",
+]
+
+
+def scrape_body(telemetry, registry) -> str:
+    """Serve one scrape over a real HTTP endpoint and return the
+    Prometheus text body (shared with chaos_smoke)."""
+    with telemetry.start_metrics_server(registry, port=0) as srv:
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ).read().decode()
+
+
+def missing_series(body: str, required) -> list:
+    return [f"required series missing: {needle!r}"
+            for needle in required if needle not in body]
+
 
 def main() -> int:
     from deeplearning4j_tpu import (MultiLayerNetwork,
@@ -122,10 +155,7 @@ def main() -> int:
                         f"{retired.value - retired_before} != 3")
 
     # -- scrape over HTTP ----------------------------------------------
-    with telemetry.start_metrics_server(registry, port=0) as srv:
-        body = urllib.request.urlopen(
-            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
-        ).read().decode()
+    body = scrape_body(telemetry, registry)
 
     series = {line.rsplit(" ", 1)[0] for line in body.splitlines()
               if line and not line.startswith("#")}
@@ -150,10 +180,8 @@ def main() -> int:
         "generation_server_slots_busy",
         "generation_server_slot_occupancy_bucket",
         "generation_server_ticks_total",
-    ]
-    for needle in required:
-        if needle not in body:
-            problems.append(f"required series missing: {needle!r}")
+    ] + RESILIENCE_SERIES
+    problems += missing_series(body, required)
     if lat.count - lat_before != 16:
         problems.append(
             f"latency histogram grew {lat.count - lat_before} != 16")

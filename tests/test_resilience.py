@@ -1,0 +1,465 @@
+"""Chaos paths: deterministic fault injection, preemption-safe
+kill-and-resume training (bit-identical continuation), NaN-loss
+skip/backoff/rollback policy, and GenerationServer watchdog recovery
+with concurrent callers."""
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (MultiLayerNetwork, NeuralNetConfiguration,
+                                resilience, telemetry)
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.layers_core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+from deeplearning4j_tpu.parallel import CheckpointListener
+from deeplearning4j_tpu.resilience import (BadStepPolicy, CancelledError,
+                                           DeadlineExceededError,
+                                           FaultInjector, InjectedFault,
+                                           PreemptionGuard,
+                                           RetryableServerError,
+                                           TrainingPreempted,
+                                           auto_resume_fit)
+
+REG = telemetry.get_registry()
+
+
+def _model(seed=3, updater=None):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Adam(learning_rate=1e-2)).list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(rng, n=96):
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)]
+    return x, y
+
+
+def _iter(x, y, bs=16):
+    return ListDataSetIterator(DataSet(x, y).batch_by(bs))
+
+
+@pytest.fixture(autouse=True)
+def _clean_preemption_flag():
+    resilience.clear_preemption()
+    yield
+    resilience.clear_preemption()
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+def test_fault_injector_deterministic_and_scoped():
+    a = FaultInjector.random_plan(seed=7, horizon=100, n_faults=4)
+    b = FaultInjector.random_plan(seed=7, horizon=100, n_faults=4)
+    assert [(s.kind, s.at) for s in a.specs] == \
+           [(s.kind, s.at) for s in b.specs]
+    inj = FaultInjector(["nan_loss@3", "data_stall@1:0.01"])
+    from deeplearning4j_tpu.resilience import faults
+    assert faults.active() is not inj
+    with inj:
+        assert faults.active() is inj
+        assert not faults.fires("nan_loss", 2)
+        assert faults.fires("nan_loss", 3)
+        assert not faults.fires("nan_loss", 3)      # fires once
+        assert faults.maybe_stall("data_stall", 1) > 0
+        with FaultInjector(["step_exception@0"]):   # shadows `inj`
+            with pytest.raises(InjectedFault, match="step_exception"):
+                faults.maybe_fail("step_exception", 0)
+        assert faults.active() is inj               # stack popped
+    assert faults.active() is not inj
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultInjector(["meteor_strike@2"])
+    env = FaultInjector.from_env("preempt@5, nan_loss@2:0.5")
+    assert [(s.kind, s.at) for s in env.specs] == [("preempt", 5),
+                                                   ("nan_loss", 2)]
+    assert FaultInjector.from_env("") is None
+
+
+# ---------------------------------------------------------------------------
+# Preemption: kill-and-resume
+# ---------------------------------------------------------------------------
+def test_preemption_kill_and_resume_bit_identical(tmp_path, rng):
+    """Checkpoint -> simulated preemption -> fresh-process restore:
+    the resumed run must finish at the SAME final loss with
+    bit-identical params as an uninterrupted run."""
+    x, y = _data(rng)
+    ref = _model()
+    ref_loss = ref.fit(_iter(x, y), n_epochs=3, async_prefetch=False)
+
+    m = _model()
+    ck = CheckpointListener(tmp_path / "ck", save_every_n_iterations=5)
+    m.set_listeners(ck)
+    resumes = REG.counter("train_resumes_total")
+    preempts = REG.counter("train_preemptions_total")
+    r0, p0 = resumes.value, preempts.value
+    with pytest.raises(TrainingPreempted) as ei:
+        with FaultInjector(["preempt@8"]):
+            m.fit(_iter(x, y), n_epochs=3, async_prefetch=False)
+    # the forced save landed at the killed iteration, synchronously
+    assert ei.value.step == 8
+    assert preempts.value - p0 == 1
+    resilience.clear_preemption()
+
+    # "restart": a fresh model restores and resumes at the exact step
+    m2 = _model(seed=99)
+    m2._build_solver()
+    ck2 = CheckpointListener(tmp_path / "ck")
+    m2.set_listeners(ck2)
+    loss2 = m2.fit(_iter(x, y), n_epochs=3, async_prefetch=False,
+                   resume=True)
+    assert resumes.value - r0 == 1
+    assert m2.iteration_count == ref.iteration_count == 18
+    assert float(loss2) == float(ref_loss)
+    for a, b in zip(_leaves(ref.params_tree), _leaves(m2.params_tree)):
+        np.testing.assert_array_equal(a, b)
+
+
+def _leaves(tree):
+    import jax
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(tree)]
+
+
+def test_resume_restores_mid_step_state_exactly(tmp_path, rng):
+    """The restored snapshot itself is bit-identical to the state the
+    preempted process carried at the kill point."""
+    x, y = _data(rng, 64)
+    m = _model()
+    ck = CheckpointListener(tmp_path / "ck2", save_every_n_iterations=100)
+    m.set_listeners(ck)
+    with pytest.raises(TrainingPreempted):
+        with FaultInjector(["preempt@5"]):
+            m.fit(_iter(x, y), n_epochs=4, async_prefetch=False)
+    resilience.clear_preemption()
+    killed = _leaves(m.params_tree)
+    m2 = _model(seed=42)
+    m2._build_solver()
+    CheckpointListener(tmp_path / "ck2").restore_into(m2)
+    assert m2.iteration_count == 6 and m2.batch_in_epoch == 2
+    for a, b in zip(killed, _leaves(m2.params_tree)):
+        np.testing.assert_array_equal(a, b)
+    # the RNG stream position travels with the checkpoint
+    np.testing.assert_array_equal(np.asarray(m._rng.state()),
+                                  np.asarray(m2._rng.state()))
+
+
+def test_preemption_guard_real_signal(tmp_path, rng):
+    """A real SIGTERM mid-fit forces the final checkpoint and raises
+    TrainingPreempted (the cooperative handler path end to end)."""
+    x, y = _data(rng, 64)
+    m = _model()
+    ck = CheckpointListener(tmp_path / "sig", save_every_n_iterations=100)
+
+    class Killer(TrainingListener):
+        def iteration_done(self, model, iteration, epoch, loss):
+            if iteration == 3:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    m.set_listeners(ck, Killer())
+    with PreemptionGuard():
+        with pytest.raises(TrainingPreempted) as ei:
+            m.fit(_iter(x, y), n_epochs=5, async_prefetch=False)
+    assert ei.value.step == 3
+    assert ck.ckpt.all_steps() == [3]
+
+
+def test_auto_resume_fit_survives_step_exception_and_preempt(tmp_path,
+                                                             rng):
+    """The restart supervisor re-enters a resumable fit across an
+    injected step crash AND a simulated preemption, and still reaches
+    the uninterrupted run's exact final state."""
+    x, y = _data(rng)
+    ref = _model()
+    ref_loss = ref.fit(_iter(x, y), n_epochs=3, async_prefetch=False)
+
+    m2 = _model()
+    ck2 = CheckpointListener(tmp_path / "sup2", save_every_n_iterations=2)
+    m2.set_listeners(ck2)
+    with FaultInjector(["step_exception@7", "preempt@12"]):
+        loss2 = auto_resume_fit(
+            lambda: m2.fit(_iter(x, y), n_epochs=3, async_prefetch=False,
+                           resume=True),
+            max_restarts=3, retry_on=(InjectedFault,))
+    assert float(loss2) == float(ref_loss)
+    for a, b in zip(_leaves(ref.params_tree), _leaves(m2.params_tree)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Bad-step policy
+# ---------------------------------------------------------------------------
+def test_nan_loss_skipped_params_unchanged_and_backoff(rng):
+    x, y = _data(rng, 32)
+    m = _model()
+    m.fit(DataSet(x, y))                      # materialize + compile
+    before = _leaves(m.params_tree)
+    skipped = REG.counter("bad_steps_skipped_total")
+    s0 = skipped.value
+    m.set_listeners(BadStepPolicy(max_consecutive=5))
+    with FaultInjector([f"nan_loss@{m.iteration_count}"]):
+        loss = m.fit(DataSet(x, y))
+    assert np.isnan(loss)                     # reported, not hidden
+    for a, b in zip(before, _leaves(m.params_tree)):
+        np.testing.assert_array_equal(a, b)   # update fully skipped
+    assert skipped.value - s0 == 1
+    assert m._lr_backoff == 0.5
+    # finite steps recover the scale back toward 1.0
+    m.set_listeners(BadStepPolicy(max_consecutive=5, recover_after=1))
+    m.fit(DataSet(x, y), n_epochs=2)
+    assert m._lr_backoff == 1.0
+
+
+def test_nan_rollback_after_k_consecutive(tmp_path, rng):
+    x, y = _data(rng)
+    m = _model()
+    ck = CheckpointListener(tmp_path / "rb", save_every_n_iterations=2)
+    rolled = REG.counter("bad_steps_rolled_back_total")
+    r0 = rolled.value
+    m.set_listeners(ck, BadStepPolicy(max_consecutive=2, checkpoint=ck))
+    with FaultInjector(["nan_loss@4", "nan_loss@5"]):
+        loss = m.fit(_iter(x, y), n_epochs=2, async_prefetch=False)
+    assert rolled.value - r0 == 1
+    assert np.isfinite(loss)                  # training recovered
+    assert m.epoch_count == 2
+
+
+def test_nan_without_checkpoint_raises_after_k(rng):
+    x, y = _data(rng, 64)
+    m = _model()
+    m.set_listeners(BadStepPolicy(max_consecutive=2))
+    with FaultInjector(["nan_loss@0", "nan_loss@1"]):
+        with pytest.raises(FloatingPointError, match="consecutive"):
+            m.fit(_iter(x, y), n_epochs=2, async_prefetch=False)
+
+
+def test_solver_lr_scale_scales_update_exactly(rng):
+    """lr_scale=0.5 must halve the applied SGD update bit-for-bit —
+    the mechanism BadStepPolicy's backoff rides on."""
+    x, y = _data(rng, 16)
+    a, b = (_model(updater=Sgd(learning_rate=0.1)) for _ in range(2))
+    ds = DataSet(x, y)
+    for m in (a, b):
+        m._check_init(); m._build_solver()
+    batch = a._batch_dict(ds)
+    key_a, key_b = a._rng.next_key(), b._rng.next_key()
+    pa0 = _leaves(a.params_tree)
+    (a.params_tree, a.opt_state, a.state_tree, _) = a._solver.step(
+        a.params_tree, a.opt_state, a.state_tree, 0, batch, key_a)
+    (b.params_tree, b.opt_state, b.state_tree, _) = b._solver.step(
+        b.params_tree, b.opt_state, b.state_tree, 0, batch, key_b,
+        lr_scale=0.5)
+    for p0, pa, pb in zip(pa0, _leaves(a.params_tree),
+                          _leaves(b.params_tree)):
+        np.testing.assert_allclose(pb - p0, (pa - p0) * 0.5,
+                                   rtol=0, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint robustness
+# ---------------------------------------------------------------------------
+def test_checkpoint_write_failure_does_not_kill_training(tmp_path, rng):
+    x, y = _data(rng)
+    m = _model()
+    ck = CheckpointListener(tmp_path / "cf", save_every_n_iterations=2)
+    m.set_listeners(ck)
+    fails = REG.counter("checkpoint_failures_total")
+    f0 = fails.value
+    with FaultInjector(["checkpoint_fail@4"]):
+        loss = m.fit(_iter(x, y), n_epochs=1, async_prefetch=False)
+    assert np.isfinite(loss)
+    assert fails.value - f0 == 1
+    ck.ckpt.wait()
+    steps = ck.ckpt.all_steps()
+    assert 4 not in steps and 2 in steps      # the failed step is absent
+
+
+def test_legacy_checkpoint_restores_without_rng_or_batch_pos(tmp_path,
+                                                             rng):
+    """Checkpoints written before the resilience layer (no rng leaf,
+    no batch_in_epoch counter) still restore — epoch-aligned."""
+    from deeplearning4j_tpu.parallel import ShardedCheckpointer
+    x, y = _data(rng, 32)
+    m = _model()
+    m.fit(DataSet(x, y))
+    ck = ShardedCheckpointer(tmp_path / "legacy", async_save=False)
+    ck.save(4, {"params": m.params_tree, "opt_state": m.opt_state,
+                "model_state": m.state_tree,
+                "counters": {"iteration": 5, "epoch": 1}})
+    ck.wait()
+    ck.close()
+    fresh = _model(seed=11)
+    fresh._build_solver()
+    lst = CheckpointListener(tmp_path / "legacy")
+    assert lst.restore_into(fresh) == 4
+    assert fresh.iteration_count == 5 and fresh.epoch_count == 1
+    for a, b in zip(_leaves(m.params_tree), _leaves(fresh.params_tree)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_orbax_import_guard(tmp_path, monkeypatch):
+    import deeplearning4j_tpu.parallel.checkpoint as ckmod
+    monkeypatch.setattr(ckmod, "ocp", None)
+    monkeypatch.setattr(ckmod, "_ORBAX_IMPORT_ERROR",
+                        ImportError("orbax not baked into this image"))
+    with pytest.raises(ImportError, match="orbax-checkpoint"):
+        ckmod.ShardedCheckpointer(tmp_path / "noorbax")
+
+
+# ---------------------------------------------------------------------------
+# Retry helper
+# ---------------------------------------------------------------------------
+def test_retry_call_bounded_backoff():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RetryableServerError("transient")
+        return "ok"
+
+    assert resilience.retry_call(flaky, retries=3, base_delay=0.001,
+                                 seed=0) == "ok"
+    assert len(calls) == 3
+    calls.clear()
+    with pytest.raises(RetryableServerError):
+        resilience.retry_call(flaky, retries=1, base_delay=0.001, seed=0)
+    assert len(calls) == 2                    # 1 try + 1 retry, bounded
+    with pytest.raises(ValueError):
+        resilience.retry_call(lambda: (_ for _ in ()).throw(
+            ValueError("not retryable")), retries=5, base_delay=0.001)
+
+
+# ---------------------------------------------------------------------------
+# GenerationServer self-healing
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def net():
+    from deeplearning4j_tpu.zoo.gpt import Gpt
+    return Gpt(vocab_size=50, max_len=32, d_model=32, n_layers=2,
+               n_heads=4, d_ff=64, seq_len=8, compute_dtype=None,
+               seed=3).init_graph()
+
+
+@pytest.fixture(scope="module")
+def offline(net):
+    from deeplearning4j_tpu.models.generation import TransformerGenerator
+    return TransformerGenerator(net)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_watchdog_recovers_scheduler_crash_concurrent_callers(net,
+                                                              offline):
+    """An injected scheduler crash with requests mid-decode: every
+    concurrent caller gets a typed retryable error, the watchdog
+    rebuilds the pool, and a retried submit succeeds with
+    offline-identical greedy output."""
+    from deeplearning4j_tpu.parallel import GenerationServer
+    restarts = REG.counter("serve_watchdog_restarts_total")
+    w0 = restarts.value
+    p = np.asarray([1, 2, 3, 4], np.int32)
+    with GenerationServer(net, n_slots=2, max_len=32,
+                          tick_timeout_s=60) as srv:
+        srv.submit(p, n_new=2, timeout=300)          # warm the compiles
+        # deterministic in-flight crash: pass 0 ingests the first
+        # request(s) and stalls 0.5s pre-tick (well under the 60s
+        # watchdog deadline), guaranteeing all three submits are
+        # enqueued; pass 1 ingests the rest and THEN hits the crash
+        # site — two decoding + one waiting, all mid-flight
+        with FaultInjector(["serve_tick_stall@0:0.5",
+                            "serve_tick_fail@1"]):
+            hs = [srv.submit_async(p, n_new=24) for _ in range(3)]
+            errs = 0
+            for h in hs:
+                try:
+                    h.result(timeout=300)
+                except RetryableServerError:
+                    errs += 1
+            assert errs == 3
+            # recovery: admission is open again and decode is exact
+            out = srv.submit(p, n_new=6, timeout=300)
+        np.testing.assert_array_equal(
+            out, offline.generate(p[None], n_new=6)[0])
+        assert restarts.value - w0 == 1
+        assert srv.healthy()
+        assert srv._healthy.value == 1               # per-instance gauge
+    assert not srv.healthy()                         # post-shutdown
+    assert srv._healthy.value == 0
+
+
+@pytest.mark.slow  # tier-1 covers this path via test_chaos_smoke
+def test_watchdog_recovers_stuck_tick_with_submit_retry(net, offline):
+    """A hung tick (stall past tick_timeout_s): the watchdog fences the
+    stuck scheduler out, and a blocking submit with retries enabled
+    rides through the recovery transparently."""
+    from deeplearning4j_tpu.parallel import GenerationServer
+    restarts = REG.counter("serve_watchdog_restarts_total")
+    w0 = restarts.value
+    p = np.asarray([5, 6, 7], np.int32)
+    with GenerationServer(net, n_slots=2, max_len=32, tick_timeout_s=1.0,
+                          submit_retries=4, retry_backoff_s=0.02) as srv:
+        srv.submit(p, n_new=2, timeout=300)          # warm the compiles
+        with FaultInjector(["serve_tick_stall@0:4.0"]):
+            out = srv.submit(p, n_new=8, timeout=300)
+        np.testing.assert_array_equal(
+            out, offline.generate(p[None], n_new=8)[0])
+    assert restarts.value - w0 >= 1
+
+
+def test_shutdown_drain_finishes_in_flight(net, offline):
+    from deeplearning4j_tpu.parallel import GenerationServer
+    p = np.asarray([9, 8, 7], np.int32)
+    srv = GenerationServer(net, n_slots=1, max_len=32, tick_timeout_s=None)
+    hs = [srv.submit_async(p, n_new=10) for _ in range(3)]
+    srv.shutdown(drain=True, timeout=300)
+    with pytest.raises(RuntimeError, match="shut down"):
+        srv.submit_async(p, n_new=2)                 # admission closed
+    ref = offline.generate(p[None], n_new=10)[0]
+    for h in hs:
+        np.testing.assert_array_equal(h.result(timeout=5), ref)
+
+
+def test_cancel_and_deadline_release_queue_entries(net, offline):
+    from deeplearning4j_tpu.parallel import GenerationServer
+    p = np.asarray([3, 1, 4], np.int32)
+    with GenerationServer(net, n_slots=1, max_len=32,
+                          tick_timeout_s=None) as srv:
+        h1 = srv.submit_async(p, n_new=25)           # holds the only slot
+        h2 = srv.submit_async(p, n_new=25)           # waits in line
+        hd = srv.submit_async(p, n_new=20, deadline_s=0.001)
+        h3 = srv.submit_async(p, n_new=6)            # behind h2/hd
+        assert h2.cancel() is True
+        with pytest.raises(CancelledError):
+            h2.result(timeout=300)
+        with pytest.raises(DeadlineExceededError):   # expired in line
+            hd.result(timeout=300)
+        # the cancelled/expired entries released their places: h3
+        # still completes, exactly
+        np.testing.assert_array_equal(
+            h3.result(timeout=300),
+            offline.generate(p[None], n_new=6)[0])
+        h1.result(timeout=300)
+        assert h1.cancel() is False                  # already done
+
+
+# ---------------------------------------------------------------------------
+# Chaos CI gate (the scripts/chaos_smoke.py fault matrix, in-process)
+# ---------------------------------------------------------------------------
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_chaos_smoke():
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "chaos_smoke.py")
+    spec = importlib.util.spec_from_file_location("chaos_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
