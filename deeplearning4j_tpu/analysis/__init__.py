@@ -1,0 +1,47 @@
+"""Static analysis + runtime sanitizers for the dl4j-tpu stack.
+
+Three passes over a shared findings model (see ISSUE/README "Static
+analysis & sanitizers"):
+
+* :mod:`~deeplearning4j_tpu.analysis.jit_lint` — trace-safety (host
+  impurity inside jit-traced functions);
+* :mod:`~deeplearning4j_tpu.analysis.concurrency_lint` — lock
+  discipline (guarded attributes accessed outside their lock on
+  thread-reachable paths);
+* :mod:`~deeplearning4j_tpu.analysis.graph_lint` — graph-IR validation
+  (dead vertices, arity, ``jax.eval_shape`` inference, f64 leaks).
+
+CLI: ``python -m deeplearning4j_tpu.analysis`` (see
+:mod:`~deeplearning4j_tpu.analysis.cli`); CI gate:
+``scripts/lint_gate.py`` against ``ANALYSIS_BASELINE.json``.
+
+Runtime companion: :mod:`~deeplearning4j_tpu.analysis.sanitize`
+(``DL4J_TPU_SANITIZE=nan,donation``) dynamically confirms the two
+statically-flagged bug classes in the fit loop and the decode tick.
+"""
+from deeplearning4j_tpu.analysis.findings import (Baseline, Finding,
+                                                  SEVERITIES,
+                                                  sort_findings)
+from deeplearning4j_tpu.analysis import sanitize
+from deeplearning4j_tpu.analysis.sanitize import SanitizerError
+
+__all__ = ["Baseline", "Finding", "SEVERITIES", "sort_findings",
+           "sanitize", "SanitizerError", "lint_paths", "lint_samediff",
+           "lint_computation_graph"]
+
+
+def lint_paths(*a, **kw):
+    from deeplearning4j_tpu.analysis.cli import lint_paths as impl
+    return impl(*a, **kw)
+
+
+def lint_samediff(*a, **kw):
+    from deeplearning4j_tpu.analysis.graph_lint import (
+        lint_samediff as impl)
+    return impl(*a, **kw)
+
+
+def lint_computation_graph(*a, **kw):
+    from deeplearning4j_tpu.analysis.graph_lint import (
+        lint_computation_graph as impl)
+    return impl(*a, **kw)

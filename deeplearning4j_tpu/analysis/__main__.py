@@ -1,0 +1,5 @@
+import sys
+
+from deeplearning4j_tpu.analysis.cli import main
+
+sys.exit(main())

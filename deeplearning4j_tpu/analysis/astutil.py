@@ -1,0 +1,169 @@
+"""Small shared AST helpers for the analysis passes.
+
+Nothing here is jax- or threading-specific: dotted-name flattening,
+parent links, scope-aware function indexing, and attribute-access
+iteration.  Both AST passes (:mod:`jit_lint`, :mod:`concurrency_lint`)
+work on plain ``ast`` trees — no imports of the linted code ever
+happen, so linting a file can never execute it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+ScopeDef = FuncDef + (ast.ClassDef, ast.Module)
+
+
+def dotted(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """Flatten ``a.b.c`` / ``a`` into ``("a","b","c")`` / ``("a",)``;
+    None for anything that is not a pure Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return tuple(reversed(parts))
+
+
+def dotted_str(node: ast.AST) -> Optional[str]:
+    d = dotted(node)
+    return ".".join(d) if d else None
+
+
+def add_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    """Child -> parent links for the whole tree."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+class FuncIndex:
+    """Every function/method in a module, with qualified names and
+    scope chains, so a reference like ``jax.jit(tick)`` or a call
+    ``self._step(...)`` can be resolved to its def without importing
+    anything."""
+
+    def __init__(self, tree: ast.Module, parents: Dict[ast.AST, ast.AST]):
+        self.tree = tree
+        self.parents = parents
+        self.defs: List[ast.AST] = [
+            n for n in ast.walk(tree) if isinstance(n, FuncDef)]
+        self.qualname: Dict[ast.AST, str] = {}
+        self.owner_class: Dict[ast.AST, Optional[ast.ClassDef]] = {}
+        # scope node -> directly nested function defs
+        self.scope_children: Dict[ast.AST, Dict[str, ast.AST]] = {}
+        for fn in self.defs:
+            chain = self._scope_chain(fn)
+            names = [getattr(s, "name", "") for s in chain
+                     if not isinstance(s, ast.Module)]
+            self.qualname[fn] = ".".join(names + [fn.name])
+            self.owner_class[fn] = next(
+                (s for s in reversed(chain)
+                 if isinstance(s, ast.ClassDef)), None)
+            scope = chain[-1] if chain else tree
+            self.scope_children.setdefault(scope, {})[fn.name] = fn
+        # method name -> defs (for attr-call resolution within module)
+        self.by_method_name: Dict[str, List[ast.AST]] = {}
+        for fn in self.defs:
+            self.by_method_name.setdefault(fn.name, []).append(fn)
+
+    def _scope_chain(self, node: ast.AST) -> List[ast.AST]:
+        """Enclosing scopes of ``node``, outermost first, excluding
+        ``node`` itself."""
+        chain: List[ast.AST] = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ScopeDef):
+                chain.append(cur)
+            cur = self.parents.get(cur)
+        return list(reversed(chain))
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, FuncDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def resolve_name(self, name: str, at: ast.AST) -> Optional[ast.AST]:
+        """Resolve a bare ``name`` reference at node ``at`` to a
+        function def, searching the enclosing scopes innermost-out,
+        then the module."""
+        fn = self.enclosing_function(at)
+        scopes: List[ast.AST] = []
+        cur: Optional[ast.AST] = fn
+        while cur is not None:
+            scopes.append(cur)
+            cur = self.parents.get(cur)
+        scopes.append(self.tree)
+        for scope in scopes:
+            hit = self.scope_children.get(scope, {}).get(name)
+            if hit is not None:
+                return hit
+        return None
+
+    def resolve_attr_method(self, attr: str, at: ast.AST
+                            ) -> List[ast.AST]:
+        """Resolve ``something.attr(...)`` to candidate method defs:
+        prefer methods of the class enclosing ``at``; fall back to any
+        same-named method in the module (cross-class, heuristic)."""
+        fn = self.enclosing_function(at)
+        cls = self.owner_class.get(fn) if fn is not None else None
+        cands = self.by_method_name.get(attr, [])
+        if cls is not None:
+            own = [c for c in cands if self.owner_class.get(c) is cls]
+            if own:
+                return own
+        return cands
+
+
+def attr_accesses(node: ast.AST, base: str = "self"
+                  ) -> Iterator[Tuple[ast.Attribute, str, str]]:
+    """Yield ``(attr_node, attr_name, kind)`` for every ``base.X``
+    access under ``node``.  ``kind``: "store" for assignment targets
+    (plain, augmented, subscript/attribute element stores, deletes),
+    else "load".  ``base.X[i] = v`` and ``base.X.append`` count as a
+    store and a load respectively — mutation through a method call is
+    invisible to syntax."""
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Attribute):
+            continue
+        if not (isinstance(n.value, ast.Name) and n.value.id == base):
+            continue
+        if isinstance(n.ctx, (ast.Store, ast.Del)):
+            yield n, n.attr, "store"
+        else:
+            yield n, n.attr, "load"
+
+
+def subscript_store_bases(node: ast.AST, base: str = "self"
+                          ) -> Iterator[Tuple[ast.Attribute, str]]:
+    """Yield ``(attr_node, name)`` for ``base.X[...] = v`` /
+    ``del base.X[...]`` / ``base.X[...] += v`` element stores — the
+    attribute itself is a Load syntactically, but the ACCESS mutates
+    the named container."""
+    for n in ast.walk(node):
+        targets: List[ast.AST] = []
+        if isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        elif isinstance(n, ast.Delete):
+            targets = list(n.targets)
+        for t in targets:
+            for tt in ast.walk(t):
+                if isinstance(tt, ast.Subscript) and \
+                        isinstance(tt.value, ast.Attribute) and \
+                        isinstance(tt.value.value, ast.Name) and \
+                        tt.value.value.id == base:
+                    yield tt.value, tt.value.attr
+
+
+def call_name(call: ast.Call) -> Optional[Tuple[str, ...]]:
+    return dotted(call.func)

@@ -1,0 +1,178 @@
+"""CLI for the static-analysis passes.
+
+::
+
+    python -m deeplearning4j_tpu.analysis [paths...]
+        --format=text|json        report format (default text)
+        --baseline=FILE           filter findings through a baseline
+        --rules=jit,conc          subset of AST passes (default both)
+        --graph=FILE.sdz          also lint a serialized SameDiff zip
+        --min-severity=warning    drop findings below this severity
+        --telemetry               count findings into the process
+                                  metrics registry
+                                  (lint_findings_total{rule=,severity=})
+
+Exit code: 1 when any finding is NOT covered by the baseline (all
+findings are "new" when no baseline is given), else 0.  The CI wrapper
+with diff-style reporting and ``--update-baseline`` lives in
+``scripts/lint_gate.py``.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+import time
+from typing import Iterable, List, Optional, Sequence
+
+from deeplearning4j_tpu.analysis import concurrency_lint, jit_lint
+from deeplearning4j_tpu.analysis.findings import (SEVERITIES, Baseline,
+                                                  Finding, sort_findings)
+
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+_AST_PASSES = {"jit": jit_lint, "conc": concurrency_lint}
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_paths(paths: Sequence[str], rules: Sequence[str] = ("jit", "conc"),
+               root: Optional[str] = None) -> List[Finding]:
+    """Run the AST passes over every .py file under ``paths``.
+    ``root`` relativizes reported paths (default: cwd)."""
+    root = os.path.abspath(root or os.getcwd())
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), root)
+        try:
+            with open(path, "rb") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="PARSE000", severity="error", path=rel,
+                line=e.lineno or 0, symbol="<module>",
+                message=f"file does not parse: {e.msg}"))
+            continue
+        for r in rules:
+            findings.extend(
+                Finding(**{**f.to_dict(), "path": rel})
+                for f in _AST_PASSES[r].lint_tree(tree, rel))
+    return findings
+
+
+def lint_graph_file(path: str) -> List[Finding]:
+    from deeplearning4j_tpu.analysis import graph_lint
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    sd = SameDiff.load(path)
+    return graph_lint.lint_samediff(sd, name=os.path.basename(path))
+
+
+def emit_telemetry(findings: Sequence[Finding]) -> None:
+    """Count findings into the process registry so report tooling
+    (check_telemetry / chaos_smoke) covers the analysis subsystem."""
+    from deeplearning4j_tpu import telemetry
+    fam = telemetry.counter(
+        "lint_findings_total",
+        "static-analysis findings emitted, by rule and severity",
+        labelnames=("rule", "severity"))
+    for f in findings:
+        fam.labels(rule=f.rule, severity=f.severity).inc()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis",
+        description="dl4j-tpu-lint: trace-safety, lock-discipline and "
+                    "graph-IR static analysis")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="ANALYSIS_BASELINE.json to filter through")
+    ap.add_argument("--rules", default="jit,conc",
+                    help="comma list of AST passes (jit,conc)")
+    ap.add_argument("--graph", action="append", default=[],
+                    help="serialized SameDiff zip to graph-lint "
+                         "(repeatable)")
+    ap.add_argument("--min-severity", choices=SEVERITIES, default="info")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="count findings into the metrics registry")
+    args = ap.parse_args(argv)
+
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    bad = [r for r in rules if r not in _AST_PASSES]
+    if bad:
+        ap.error(f"unknown rules {bad}; choose from "
+                 f"{sorted(_AST_PASSES)}")
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+
+    # anchor reported paths (= baseline keys) to the baseline file's
+    # directory, so the documented invocation works from any cwd; bare
+    # runs relativize against cwd as before
+    root = (os.path.dirname(os.path.abspath(args.baseline))
+            if args.baseline else None)
+    t0 = time.perf_counter()
+    findings = lint_paths(paths, rules=rules, root=root)
+    for g in args.graph:
+        findings.extend(lint_graph_file(g))
+    cut = _SEV_RANK[args.min_severity]
+    findings = [f for f in findings if _SEV_RANK[f.severity] <= cut]
+    findings = sort_findings(findings)
+
+    if args.baseline:
+        baseline = Baseline.load(args.baseline)
+        new, baselined, stale = baseline.diff(findings)
+    else:
+        new, baselined, stale = findings, [], []
+
+    if args.telemetry:
+        emit_telemetry(findings)
+
+    elapsed = time.perf_counter() - t0
+    if args.format == "json":
+        print(json.dumps({
+            "ok": not new,
+            "elapsed_s": round(elapsed, 3),
+            "counts": _counts(findings),
+            "new": [f.to_dict() for f in new],
+            "baselined": len(baselined),
+            "stale_baseline_keys": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if baselined:
+            print(f"-- {len(baselined)} finding(s) covered by baseline")
+        if stale:
+            print(f"-- {len(stale)} stale baseline key(s) "
+                  f"(fixed debt; prune with lint_gate --update-baseline)")
+        c = _counts(findings)
+        print(f"== {len(findings)} finding(s) "
+              f"({c.get('error', 0)} error, {c.get('warning', 0)} "
+              f"warning, {c.get('info', 0)} info), {len(new)} new, "
+              f"in {elapsed:.2f}s")
+    return 1 if new else 0
+
+
+def _counts(findings: Sequence[Finding]):
+    out = {}
+    for f in findings:
+        out[f.severity] = out.get(f.severity, 0) + 1
+    return out
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,319 @@
+"""``concurrency_lint`` — static lock-discipline analysis.
+
+For every class in a module the pass infers which instance attributes
+are LOCK-GUARDED, then flags accesses to those attributes that happen
+outside the lock on any code path another thread can run.  This is a
+static race detector for the host-side schedulers
+(``generation_server.py``, ``inference.py``, ``telemetry/registry.py``)
+— the bug class PR 3 fixed by hand (scheduler state mutated outside
+the watchdog's lock) is exactly what it catches.
+
+Inference, per class:
+
+* **lock attributes**: ``self.X = threading.Lock()/RLock()/Condition()``
+  assignments, plus any attribute whose name contains ``lock``;
+* **guarded attributes**: targets of ``self.Y = ...`` stores (plain,
+  augmented, and element stores ``self.Y[i] = ...`` / ``del
+  self.Y[i]``) that appear lexically inside a ``with self.<lock>:``
+  block, or anywhere inside a method whose name ends in ``_locked``
+  (the "caller holds the lock" convention);
+* **checked entry points**: methods named as ``threading.Thread(
+  target=self.X)`` targets, plus — when the class starts threads or
+  owns a lock (either is an advertisement of concurrent use) — every
+  public method; plus everything transitively reachable from those via
+  ``self.meth()`` calls.  Base classes defined in the same module are
+  folded in so ``Counter.inc -> _Family._default`` resolves.
+
+``__init__`` (and ``__enter__``) are exempt: construction happens
+before the object is shared.  Methods ending in ``_locked`` are exempt
+as access sites (their contract is "caller holds the lock") but calls
+to them from outside a ``with self.<lock>:`` block are themselves
+flagged.
+
+Known blind spots (ROADMAP): lock objects not stored on ``self``
+(module-level locks, locks passed in), aliasing (``s = self;
+s.attr``), and cross-module subclassing.
+
+Rules
+-----
+CONC201 (error)   write to a lock-guarded attribute outside the lock
+                  in a thread-reachable method.
+CONC202 (warning) read of a lock-guarded attribute outside the lock in
+                  a thread-reachable method.
+CONC203 (error)   ``*_locked`` method called outside a ``with
+                  self.<lock>:`` block.
+CONC204 (warning) lock-free class shares mutable state: the class
+                  starts a thread, has no lock at all, and an
+                  attribute is written outside ``__init__`` and also
+                  accessed from another checked method.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from deeplearning4j_tpu.analysis.astutil import (FuncDef, add_parents,
+                                                 attr_accesses, dotted,
+                                                 subscript_store_bases)
+from deeplearning4j_tpu.analysis.findings import Finding
+
+_EXEMPT_METHODS = {"__init__", "__new__", "__enter__", "__post_init__",
+                   "__del__"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+
+
+def _is_lock_ctor(expr: ast.AST) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    parts = dotted(expr.func)
+    return parts is not None and parts[-1] in _LOCK_CTORS
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.name = node.name
+        self.bases: List[str] = [p[-1] for p in
+                                 (dotted(b) for b in node.bases) if p]
+        self.methods: Dict[str, ast.AST] = {
+            n.name: n for n in node.body if isinstance(n, FuncDef)}
+        self.lock_attrs: Set[str] = set()
+        self.guarded: Set[str] = set()
+        self.thread_targets: Set[str] = set()
+        self.starts_threads = False
+        self.stores_by_method: Dict[str, Set[str]] = {}
+        self.loads_by_method: Dict[str, Set[str]] = {}
+        self.calls_by_method: Dict[str, Set[str]] = {}
+
+
+class _ModuleLint:
+    def __init__(self, tree: ast.Module, path: str):
+        self.tree = tree
+        self.path = path
+        self.parents = add_parents(tree)
+        self.findings: List[Finding] = []
+        self.classes: Dict[str, _ClassInfo] = {}
+
+    def run(self) -> List[Finding]:
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = self._scan_class(node)
+        for ci in self.classes.values():
+            self._merge_bases(ci)
+        for ci in self.classes.values():
+            self._lint_class(ci)
+        return self.findings
+
+    # -- per-class fact gathering --------------------------------------
+    def _scan_class(self, node: ast.ClassDef) -> _ClassInfo:
+        ci = _ClassInfo(node)
+        # lock attributes
+        for n in ast.walk(node):
+            if isinstance(n, ast.Assign) and _is_lock_ctor(n.value):
+                for t in n.targets:
+                    parts = dotted(t)
+                    if parts and parts[0] == "self" and len(parts) == 2:
+                        ci.lock_attrs.add(parts[1])
+        for _, name, _ in attr_accesses(node):
+            if "lock" in name.lower():
+                ci.lock_attrs.add(name)
+        # thread targets
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                parts = dotted(n.func)
+                if parts and parts[-1] == "Thread":
+                    ci.starts_threads = True
+                    for kw in n.keywords:
+                        if kw.arg == "target":
+                            tp = dotted(kw.value)
+                            if tp and tp[0] == "self" and len(tp) == 2:
+                                ci.thread_targets.add(tp[1])
+        # guarded attributes + per-method access maps
+        for mname, m in ci.methods.items():
+            in_lock = self._locked_regions(m, ci.lock_attrs)
+            whole_locked = mname.endswith("_locked")
+            stores, loads = set(), set()
+            for attr_node, name, kind in attr_accesses(m):
+                if name in ci.lock_attrs:
+                    continue
+                if kind == "store":
+                    stores.add(name)
+                    if whole_locked or attr_node in in_lock:
+                        ci.guarded.add(name)
+                else:
+                    loads.add(name)
+            for attr_node, name in subscript_store_bases(m):
+                if name in ci.lock_attrs:
+                    continue
+                stores.add(name)
+                if whole_locked or attr_node in in_lock:
+                    ci.guarded.add(name)
+            ci.stores_by_method[mname] = stores
+            ci.loads_by_method[mname] = loads
+            ci.calls_by_method[mname] = {
+                p[1] for p in (dotted(c.func) for c in ast.walk(m)
+                               if isinstance(c, ast.Call))
+                if p and p[0] == "self" and len(p) == 2}
+        return ci
+
+    def _locked_regions(self, method: ast.AST,
+                        lock_attrs: Set[str]) -> Set[ast.AST]:
+        """All nodes lexically inside a ``with self.<lock>:`` block."""
+        inside: Set[ast.AST] = set()
+        for n in ast.walk(method):
+            if not isinstance(n, ast.With):
+                continue
+            if not any(
+                    (lambda p: p and p[0] == "self" and len(p) == 2
+                     and p[1] in lock_attrs)(dotted(item.context_expr))
+                    for item in n.items):
+                continue
+            for stmt in n.body:
+                for sub in ast.walk(stmt):
+                    inside.add(sub)
+        return inside
+
+    def _merge_bases(self, ci: _ClassInfo, depth: int = 0) -> None:
+        """Fold same-module base classes' facts into the subclass so
+        ``Counter.inc -> _Family._default`` style chains resolve."""
+        if depth > 4:
+            return
+        for bname in ci.bases:
+            base = self.classes.get(bname)
+            if base is None:
+                continue
+            self._merge_bases(base, depth + 1)
+            ci.lock_attrs |= base.lock_attrs
+            ci.guarded |= base.guarded
+            ci.thread_targets |= base.thread_targets
+            ci.starts_threads |= base.starts_threads
+            for mname, m in base.methods.items():
+                if mname not in ci.methods:
+                    ci.methods[mname] = m
+                    ci.stores_by_method[mname] = \
+                        base.stores_by_method.get(mname, set())
+                    ci.loads_by_method[mname] = \
+                        base.loads_by_method.get(mname, set())
+                    ci.calls_by_method[mname] = \
+                        base.calls_by_method.get(mname, set())
+
+    # -- rule evaluation -----------------------------------------------
+    def _reachable_methods(self, ci: _ClassInfo) -> Set[str]:
+        entries = set(ci.thread_targets)
+        if ci.starts_threads or ci.lock_attrs:
+            entries |= {m for m in ci.methods if not m.startswith("_")}
+        seen: Set[str] = set()
+        frontier = [m for m in entries if m in ci.methods]
+        while frontier:
+            m = frontier.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            for callee in ci.calls_by_method.get(m, ()):
+                if callee in ci.methods and callee not in seen:
+                    frontier.append(callee)
+        return seen
+
+    def _lint_class(self, ci: _ClassInfo) -> None:
+        reachable = self._reachable_methods(ci)
+        if ci.lock_attrs and ci.guarded:
+            self._lint_guarded(ci, reachable)
+        if ci.lock_attrs:
+            self._lint_locked_suffix_calls(ci)
+        if not ci.lock_attrs and ci.starts_threads:
+            self._lint_lockfree_shared(ci, reachable)
+
+    def _lint_guarded(self, ci: _ClassInfo, reachable: Set[str]) -> None:
+        for mname in sorted(reachable):
+            if mname in _EXEMPT_METHODS or mname.endswith("_locked"):
+                continue
+            m = ci.methods.get(mname)
+            if m is None:
+                continue
+            in_lock = self._locked_regions(m, ci.lock_attrs)
+            qn = f"{ci.name}.{mname}"
+            reported: Set[Tuple[str, str, int]] = set()
+
+            def check(attr_node: ast.AST, name: str, kind: str) -> None:
+                if name not in ci.guarded or attr_node in in_lock:
+                    return
+                key = (name, kind, attr_node.lineno)
+                if key in reported:
+                    return
+                reported.add(key)
+                if kind == "store":
+                    self.findings.append(Finding(
+                        "CONC201", "error", self.path,
+                        attr_node.lineno, qn,
+                        f"write to lock-guarded attribute "
+                        f"'self.{name}' outside the lock",
+                        f"wrap in 'with self.{sorted(ci.lock_attrs)[0]}:'"
+                    ))
+                else:
+                    self.findings.append(Finding(
+                        "CONC202", "warning", self.path,
+                        attr_node.lineno, qn,
+                        f"read of lock-guarded attribute "
+                        f"'self.{name}' outside the lock",
+                        "read under the lock, or document why the "
+                        "race is benign and baseline this finding"))
+
+            sub_store_nodes = {id(a) for a, _ in
+                               subscript_store_bases(m)}
+            for attr_node, name, kind in attr_accesses(m):
+                if id(attr_node) in sub_store_nodes:
+                    kind = "store"
+                check(attr_node, name, kind)
+
+    def _lint_locked_suffix_calls(self, ci: _ClassInfo) -> None:
+        for mname, m in ci.methods.items():
+            in_lock = self._locked_regions(m, ci.lock_attrs)
+            if mname.endswith("_locked"):
+                continue     # _locked calling _locked: caller's caller
+            for c in ast.walk(m):
+                if not isinstance(c, ast.Call):
+                    continue
+                parts = dotted(c.func)
+                if not (parts and parts[0] == "self" and len(parts) == 2
+                        and parts[1].endswith("_locked")):
+                    continue
+                if c not in in_lock:
+                    self.findings.append(Finding(
+                        "CONC203", "error", self.path, c.lineno,
+                        f"{ci.name}.{mname}",
+                        f"'self.{parts[1]}()' called outside a 'with "
+                        f"self.<lock>:' block — the _locked suffix "
+                        "declares the caller must hold the lock",
+                        "move the call inside the locked region"))
+
+    def _lint_lockfree_shared(self, ci: _ClassInfo,
+                              reachable: Set[str]) -> None:
+        checked = {m for m in reachable
+                   if m not in _EXEMPT_METHODS}
+        for attr in sorted({
+                a for m in checked
+                for a in ci.stores_by_method.get(m, ())}):
+            writers = {m for m in checked
+                       if attr in ci.stores_by_method.get(m, ())}
+            readers = {m for m in checked
+                       if attr in ci.loads_by_method.get(m, ())}
+            if writers and (readers | writers) - writers or \
+                    len(writers) > 1:
+                first = ci.methods[sorted(writers)[0]]
+                self.findings.append(Finding(
+                    "CONC204", "warning", self.path, first.lineno,
+                    f"{ci.name}.{sorted(writers)[0]}",
+                    f"attribute 'self.{attr}' is written here and "
+                    f"accessed from {sorted((readers | writers) - {sorted(writers)[0]}) or '[same method]'} "
+                    "with no lock in a thread-spawning class",
+                    "guard with a threading.Lock, or use a "
+                    "threading.Event for flags"))
+
+
+def lint_tree(tree: ast.Module, path: str) -> List[Finding]:
+    return _ModuleLint(tree, path).run()
+
+
+def lint_source(source: str, path: str = "<string>") -> List[Finding]:
+    return lint_tree(ast.parse(source), path)

@@ -1,0 +1,156 @@
+"""Shared findings model for the static-analysis passes.
+
+Every pass (``jit_lint``, ``concurrency_lint``, ``graph_lint``) emits
+:class:`Finding` records — one defect each, carrying a stable rule id,
+a severity, a location, and a fix hint — so the CLI, the CI gate, and
+the baseline workflow treat all three uniformly.
+
+Baseline design: a finding's identity deliberately EXCLUDES the line
+number.  Keys are ``rule::path::symbol::message`` — an unrelated edit
+that shifts a flagged function down 40 lines must not invalidate the
+checked-in baseline, while touching the flagged code itself (message
+or enclosing symbol changes) correctly surfaces the finding as new.
+Duplicate keys are tracked by COUNT: a second unguarded read of the
+same attribute in the same method is a new finding even though its key
+already exists.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Severity order, most severe first.  ``error`` findings are the CI
+#: gate's hard bar (fix, don't baseline, unless justified); ``warning``
+#: is a real smell worth a baseline justification; ``info`` is advice.
+SEVERITIES = ("error", "warning", "info")
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One static-analysis defect."""
+
+    rule: str            # stable id, e.g. "JIT101"
+    severity: str        # "error" | "warning" | "info"
+    path: str            # repo-relative file (or "<graph:NAME>")
+    line: int            # 1-based; 0 when not line-anchored (graph IR)
+    symbol: str          # enclosing qualified symbol ("Class.method")
+    message: str         # line-free statement of the defect
+    fix_hint: str = ""   # how to make it go away
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"severity {self.severity!r} not in {SEVERITIES}")
+
+    @property
+    def key(self) -> str:
+        """Line-insensitive identity used by the baseline."""
+        return f"{self.rule}::{self.path}::{self.symbol}::{self.message}"
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Finding":
+        return cls(**{f.name: d[f.name]
+                      for f in dataclasses.fields(cls) if f.name in d})
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        hint = f"  [fix: {self.fix_hint}]" if self.fix_hint else ""
+        return (f"{self.severity.upper():7s} {self.rule} {loc} "
+                f"({self.symbol}) {self.message}{hint}")
+
+
+def sort_findings(findings: Iterable[Finding]) -> List[Finding]:
+    return sorted(findings,
+                  key=lambda f: (_SEV_RANK[f.severity], f.path, f.line,
+                                 f.rule, f.message))
+
+
+def max_severity(findings: Iterable[Finding]) -> Optional[str]:
+    ranks = [_SEV_RANK[f.severity] for f in findings]
+    return SEVERITIES[min(ranks)] if ranks else None
+
+
+# ---------------------------------------------------------------------------
+# Baseline: checked-in set of accepted pre-existing findings
+# ---------------------------------------------------------------------------
+
+class Baseline:
+    """The checked-in findings debt ledger (``ANALYSIS_BASELINE.json``).
+
+    Each entry is a finding key, an occurrence count, and a one-line
+    human justification for why it is accepted rather than fixed.  The
+    gate (:mod:`scripts.lint_gate`) fails only on findings NOT covered
+    here — new code meets the bar immediately, old debt is explicit."""
+
+    def __init__(self, entries: Optional[Dict[str, Dict]] = None):
+        # key -> {"count": int, "justification": str}
+        self.entries: Dict[str, Dict] = dict(entries or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path) as fh:
+            doc = json.load(fh)
+        entries = {}
+        for e in doc.get("entries", []):
+            entries[e["key"]] = {
+                "count": int(e.get("count", 1)),
+                "justification": e.get("justification", ""),
+            }
+        return cls(entries)
+
+    def save(self, path: str) -> None:
+        doc = {
+            "version": 1,
+            "tool": "deeplearning4j_tpu.analysis",
+            "note": ("accepted pre-existing findings; keys are "
+                     "line-insensitive (rule::path::symbol::message). "
+                     "Regenerate with scripts/lint_gate.py "
+                     "--update-baseline, then fill in justifications."),
+            "entries": [
+                {"key": k, "count": v["count"],
+                 "justification": v["justification"]}
+                for k, v in sorted(self.entries.items())
+            ],
+        }
+        with open(path, "w") as fh:
+            json.dump(doc, fh, indent=2)
+            fh.write("\n")
+
+    def diff(self, findings: Sequence[Finding]
+             ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """Split ``findings`` against the baseline.
+
+        Returns ``(new, baselined, stale_keys)``: findings beyond each
+        key's baselined count are new; keys in the baseline that the
+        run no longer produces at all are stale (fixed debt — prune
+        them with ``--update-baseline``)."""
+        seen = Counter(f.key for f in findings)
+        budget = {k: v["count"] for k, v in self.entries.items()}
+        new: List[Finding] = []
+        baselined: List[Finding] = []
+        used: Counter = Counter()
+        for f in sort_findings(findings):
+            if used[f.key] < budget.get(f.key, 0):
+                used[f.key] += 1
+                baselined.append(f)
+            else:
+                new.append(f)
+        stale = [k for k in self.entries if seen.get(k, 0) == 0]
+        return new, baselined, sorted(stale)
+
+    def updated_with(self, findings: Sequence[Finding]) -> "Baseline":
+        """A baseline covering exactly ``findings``, preserving the
+        justifications of keys that survive."""
+        counts = Counter(f.key for f in findings)
+        entries = {}
+        for k, n in counts.items():
+            old = self.entries.get(k, {})
+            entries[k] = {"count": n,
+                          "justification": old.get("justification", "")}
+        return Baseline(entries)

@@ -1,0 +1,245 @@
+"""``graph_lint`` — static validation of graph IR before build.
+
+Validates :class:`~deeplearning4j_tpu.autodiff.samediff.SameDiff`
+graphs (and ``ComputationGraphConfiguration`` vertex graphs) WITHOUT
+executing them on device: structural checks are pure host walks, and
+shape/dtype inference goes through ``jax.eval_shape`` — abstract
+evaluation only, no device memory is allocated for activations.
+
+This is the pass that would have caught the
+``fold_flatten_reshapes`` axis bug class at rewrite time instead of at
+numerics-parity time: a rewrite that orphans a vertex, breaks an op's
+arity, or changes an inferred output shape/dtype shows up here
+immediately (see ``rewrites.optimize_for_tpu``'s
+``DL4J_TPU_REWRITE_CHECK=1`` mode, which wraps every pass in a
+shape-signature parity assertion built on :func:`infer_shapes`).
+
+Rules
+-----
+GRAPH301 (error)   dangling input: an op consumes a name that no
+                   variable declares and no op produces.
+GRAPH302 (warning) dead vertex: none of an op's outputs are consumed,
+                   designated outputs, or loss variables — dead compute
+                   that a rewrite or importer forgot to prune.
+GRAPH303 (error)   fan-in arity mismatch: an op's input count cannot
+                   satisfy its registered lowering's signature.
+GRAPH304 (warning) float64 leak: a CONSTANT/VARIABLE carries float64
+                   values — under jax's default x64-disabled config it
+                   silently downcasts; with x64 enabled it promotes
+                   every downstream op to f64 (2x HBM, no MXU).
+GRAPH305 (error)   shape inference failed: abstract evaluation of the
+                   graph raised — the graph cannot trace.
+GRAPH306 (warning) inferred f64 output: an output abstractly evaluates
+                   to float64 from float32 inputs (silent promotion in
+                   the op chain).
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.analysis.findings import Finding
+
+#: dimension used in place of unknown (None/-1) placeholder dims during
+#: abstract evaluation — any positive size works for shape PARITY
+#: checks, and 2 keeps broadcast bugs visible where 1 would hide them.
+PROBE_DIM = 2
+
+
+def _op_index(sd) -> int:
+    return {id(n): i for i, n in enumerate(sd.ops)}
+
+
+def _finding(rule, severity, graph_name, symbol, message, hint=""):
+    return Finding(rule=rule, severity=severity,
+                   path=f"<graph:{graph_name}>", line=0, symbol=symbol,
+                   message=message, fix_hint=hint)
+
+
+def _arity_bounds(fn) -> Tuple[int, Optional[int]]:
+    """(min, max) positional-input arity of an op lowering; max None
+    means unbounded (*args)."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return 0, None
+    lo = hi = 0
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            hi += 1
+            if p.default is p.empty:
+                lo += 1
+        elif p.kind == p.VAR_POSITIONAL:
+            return lo, None
+    return lo, hi
+
+
+def lint_samediff(sd, name: str = "samediff",
+                  infer: bool = True) -> List[Finding]:
+    """Run every structural + inference rule on one SameDiff graph."""
+    from deeplearning4j_tpu.autodiff.ops import OP_REGISTRY
+
+    findings: List[Finding] = []
+    produced = {o for n in sd.ops for o in n.outputs}
+    consumed: Dict[str, int] = {}
+    for n in sd.ops:
+        for i in n.inputs:
+            consumed[i] = consumed.get(i, 0) + 1
+    protected = set(sd.outputs or ()) | set(sd.loss_variables)
+
+    for idx, node in enumerate(sd.ops):
+        sym = f"{node.op_name}#{idx}"
+        # GRAPH301: dangling inputs
+        for inp in node.inputs:
+            if inp not in sd.vars and inp not in produced:
+                findings.append(_finding(
+                    "GRAPH301", "error", name, sym,
+                    f"op '{node.op_name}' consumes undeclared name "
+                    f"'{inp}'",
+                    "declare the variable or fix the rewrite that "
+                    "renamed it"))
+        # GRAPH302: dead vertices
+        if not any(o in consumed or o in protected
+                   for o in node.outputs):
+            findings.append(_finding(
+                "GRAPH302", "warning", name, sym,
+                f"dead vertex: no output of '{node.op_name}' "
+                f"(outputs {node.outputs}) is consumed, designated, "
+                "or a loss variable",
+                "prune it (rewrites should drop orphaned nodes) or "
+                "designate the output"))
+        # GRAPH303: arity vs the registered lowering
+        opdef = OP_REGISTRY.get(node.op_name)
+        if opdef is not None and node.op_name not in ("while_loop",
+                                                      "cond"):
+            lo, hi = _arity_bounds(opdef.fn)
+            n_in = len(node.inputs)
+            if n_in < lo or (hi is not None and n_in > hi):
+                bound = f">= {lo}" if hi is None else \
+                    (f"exactly {lo}" if lo == hi else f"{lo}..{hi}")
+                findings.append(_finding(
+                    "GRAPH303", "error", name, sym,
+                    f"op '{node.op_name}' has {n_in} inputs but its "
+                    f"lowering takes {bound}",
+                    "fix the node's input list"))
+
+    # GRAPH304: stored f64 leaves
+    for vname, val in sd.values.items():
+        if np.asarray(val).dtype == np.float64:
+            var = sd.vars.get(vname)
+            kind = var.var_type if var is not None else "value"
+            findings.append(_finding(
+                "GRAPH304", "warning", name, f"{kind}:{vname}",
+                f"{kind.lower()} '{vname}' is stored as float64 "
+                "(x64-off jax silently downcasts it; x64-on promotes "
+                "the whole downstream graph)",
+                "store as float32 (np.float32 scalar or "
+                ".astype) at creation"))
+
+    if infer and not findings_has_errors(findings):
+        findings.extend(_infer_findings(sd, name))
+    return findings
+
+
+def findings_has_errors(findings: Sequence[Finding]) -> bool:
+    return any(f.severity == "error" for f in findings)
+
+
+def infer_shapes(sd, outputs: Optional[Sequence[str]] = None,
+                 probe_dim: int = PROBE_DIM) -> Dict[str, Tuple]:
+    """Abstract shape/dtype inference over a SameDiff graph via
+    ``jax.eval_shape`` — no device buffers are created for
+    placeholders or activations.  Unknown placeholder dims (None/-1)
+    are probed with ``probe_dim``.  Returns ``{output_name: (shape,
+    dtype_str)}``.  Raises whatever the trace raises (callers turn
+    that into GRAPH305)."""
+    import jax
+
+    outs = list(outputs) if outputs is not None else _terminal_outputs(sd)
+    if not outs:
+        return {}
+    ph = [v for v in sd.vars.values() if v.var_type == "PLACEHOLDER"]
+    feeds = {}
+    for v in ph:
+        shape = tuple((probe_dim if (d is None or int(d) < 0) else int(d))
+                      for d in (v.shape or ()))
+        feeds[v.name] = jax.ShapeDtypeStruct(shape, np.dtype(v.dtype))
+    needed = sd._needed_for(outs)
+
+    def run(feed_vals):
+        env = sd._run_graph(sd._param_values(), feed_vals, needed)
+        return [env[o] for o in outs]
+
+    res = jax.eval_shape(run, feeds)
+    return {o: (tuple(r.shape), str(np.dtype(r.dtype)))
+            for o, r in zip(outs, res)}
+
+
+def _terminal_outputs(sd) -> List[str]:
+    if sd.outputs:
+        return list(sd.outputs)
+    consumed = {i for n in sd.ops for i in n.inputs}
+    outs = [o for n in sd.ops for o in n.outputs if o not in consumed]
+    return outs + [l for l in sd.loss_variables if l not in outs]
+
+
+def _infer_findings(sd, name: str) -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        shapes = infer_shapes(sd)
+    except Exception as e:  # noqa: BLE001 — any trace failure is the finding
+        findings.append(_finding(
+            "GRAPH305", "error", name, "<trace>",
+            f"shape inference failed: {type(e).__name__}: {e}",
+            "the graph cannot trace — fix the structure before build"))
+        return findings
+    f32_world = not any(
+        np.asarray(v).dtype == np.float64 for v in sd.values.values())
+    for out, (shape, dtype) in sorted(shapes.items()):
+        if dtype == "float64" and f32_world:
+            findings.append(_finding(
+                "GRAPH306", "warning", name, f"output:{out}",
+                f"output '{out}' infers as float64 {shape} from "
+                "float32 inputs — an op in the chain silently "
+                "promotes",
+                "find the promoting op (Python float scalars in "
+                "attrs are the usual culprit) and cast"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# ComputationGraph configuration checks
+# ---------------------------------------------------------------------------
+
+def lint_computation_graph(conf, name: str = "graph") -> List[Finding]:
+    """Structural checks on a built ``ComputationGraphConfiguration``:
+    the builder already rejects unknown inputs and arity at build
+    time, but graphs can also arrive via ``from_dict``/``from_json``
+    (import paths) where nothing re-validates."""
+    findings: List[Finding] = []
+    known = set(conf.network_inputs) | set(conf.vertex_inputs)
+    for vname, ins in conf.vertex_inputs.items():
+        for i in ins:
+            if i not in known:
+                findings.append(_finding(
+                    "GRAPH301", "error", name, vname,
+                    f"vertex '{vname}' consumes unknown input '{i}'",
+                    "fix the vertex wiring"))
+    # GRAPH302: vertices no network output depends on
+    needed = set(conf.network_outputs)
+    frontier = list(needed)
+    while frontier:
+        v = frontier.pop()
+        for i in conf.vertex_inputs.get(v, ()):
+            if i not in needed:
+                needed.add(i)
+                frontier.append(i)
+    for vname in conf.vertex_inputs:
+        if vname not in needed:
+            findings.append(_finding(
+                "GRAPH302", "warning", name, vname,
+                f"dead vertex: '{vname}' feeds no network output",
+                "remove it or add it to set_outputs"))
+    return findings

@@ -1,0 +1,482 @@
+"""``jit_lint`` — trace-safety static analysis.
+
+Identifies the module's TRACE CONTEXTS — functions that execute under a
+jax tracer — and flags host-side impurity inside them.  A traced
+function runs ONCE per compilation, not once per call: a ``time.time()``
+inside it bakes the trace-time clock into the compiled program, a
+``print`` fires only on recompiles, a ``self.x = ...`` mutates host
+state at trace time, and a Python ``if`` on a traced value either
+crashes (ConcretizationTypeError) or silently specializes.
+
+Trace contexts are found purely syntactically (no imports, no
+execution):
+
+* functions decorated with ``jit``/``pjit`` (including
+  ``@partial(jax.jit, ...)``);
+* functions passed to ``jax.jit(fn, ...)`` / ``pjit`` / ``shard_map`` /
+  ``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` / ``lax.fori_loop``
+  / ``vmap`` / ``pmap`` / ``grad`` call sites (the repo's dominant
+  idiom: a nested ``def tick(...)`` returned as ``jax.jit(tick,
+  donate_argnums=...)``);
+* transitively, functions CALLED from a trace context in the same
+  module — bare names resolve through the enclosing scopes,
+  ``obj.meth(...)`` resolves to same-module methods by name.
+
+Known blind spots (ROADMAP): tracer flow across module boundaries, and
+functions reaching jit only through data (callback tables).
+
+Rules
+-----
+JIT101 (error)   host-impure call: ``time.*`` / ``random.*`` /
+                 ``np.random.*`` / ``print`` / ``input`` / ``open`` /
+                 ``datetime.*`` inside a trace context (``jax.random``
+                 is fine — it is traced PRNG, not host PRNG).
+JIT102 (warning) host-state mutation: ``global`` declarations or
+                 ``self.<attr>`` stores inside a trace context.
+JIT103 (warning) tracer-dependent Python branch: ``if``/``while`` whose
+                 test reads a traced (non-static) parameter of the
+                 trace context.  Shape-derived tests (``len``,
+                 ``.shape``/``.ndim``/``.dtype``), ``is None`` checks
+                 and ``isinstance`` are static and skipped.
+JIT104 (error)   non-hashable static argument: a call site of a jitted
+                 function passes a list/dict/set (literal or
+                 constructor) at a ``static_argnums`` position.
+JIT105 (warning) donated-buffer reuse: an argument at a
+                 ``donate_argnums`` position of a jitted call is read
+                 again after the call without an intervening rebind —
+                 the buffer may already be invalidated in place.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.analysis.astutil import (FuncDef, FuncIndex,
+                                                 add_parents, dotted)
+from deeplearning4j_tpu.analysis.findings import Finding
+
+# wrapper name -> positions of the traced-function argument(s)
+_TRACED_ARG_POS: Dict[str, Tuple[int, ...]] = {
+    "jit": (0,), "pjit": (0,), "shard_map": (0,), "scan": (0,),
+    "while_loop": (0, 1), "cond": (1, 2), "fori_loop": (2,),
+    "vmap": (0,), "pmap": (0,), "grad": (0,), "value_and_grad": (0,),
+    "checkpoint": (0,), "remat": (0,), "custom_jvp": (0,),
+    "custom_vjp": (0,), "eval_shape": (0,),
+}
+# dotted roots under which the wrapper names are trusted; a bare name
+# (``from jax import jit``) is accepted for the unambiguous ones
+_TRACE_ROOTS = {"jax", "lax", "pjit"}
+_BARE_OK = {"jit", "pjit", "shard_map", "vmap", "pmap", "grad",
+            "value_and_grad"}
+
+_HOST_CALL_ROOTS = {"time", "random", "datetime"}
+_HOST_BUILTINS = {"print", "input", "open"}
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+# calls whose results are static under tracing (shape/type/structure
+# queries); a param appearing only inside one is not a tracer read
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "range",
+                 "type", "int", "bool", "float", "str", "tuple",
+                 "ndim", "shape", "rank", "tree_structure"}
+# methods through which an attribute access DOES read traced data —
+# any other `x.attr` in a test is treated as static config
+_TRACER_REDUCERS = {"any", "all", "item", "sum", "max", "min", "mean",
+                    "prod"}
+
+
+def _is_trace_wrapper(parts: Tuple[str, ...]) -> Optional[str]:
+    """The wrapper name when ``parts`` spells a tracing transform."""
+    last = parts[-1]
+    if last not in _TRACED_ARG_POS:
+        return None
+    if len(parts) == 1:
+        return last if last in _BARE_OK else None
+    return last if parts[0] in _TRACE_ROOTS else None
+
+
+def _static_names_from_call(call: ast.Call, fn: ast.AST) -> Set[str]:
+    """Parameter NAMES of ``fn`` made static by a jit call's
+    static_argnums/static_argnames keywords."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args] \
+        if isinstance(fn, FuncDef) else []
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+        elif kw.arg == "static_argnums":
+            for i in _int_elems(kw.value):
+                if 0 <= i < len(params):
+                    out.add(params[i])
+    return out
+
+
+def _int_elems(node: ast.AST) -> List[int]:
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                and not isinstance(n.value, bool):
+            out.append(n.value)
+    return out
+
+
+class _ModuleLint:
+    def __init__(self, tree: ast.Module, path: str):
+        self.tree = tree
+        self.path = path
+        self.parents = add_parents(tree)
+        self.index = FuncIndex(tree, self.parents)
+        self.findings: List[Finding] = []
+        # traced def -> static param names (union over entry sites)
+        self.traced: Dict[ast.AST, Set[str]] = {}
+        # dotted target name -> (static positions, donate positions)
+        self.jitted_objects: Dict[Tuple[str, ...],
+                                  Tuple[Set[int], Set[int]]] = {}
+
+    # -- entry discovery ----------------------------------------------
+    def collect_entries(self) -> None:
+        for fn in self.index.defs:
+            for deco in fn.decorator_list:
+                call = deco if isinstance(deco, ast.Call) else None
+                target = call.func if call is not None else deco
+                parts = dotted(target)
+                if parts and _is_trace_wrapper(parts):
+                    self._mark(fn, _static_names_from_call(call, fn)
+                               if call else set())
+                elif call is not None and parts is None:
+                    pass
+                elif call is not None and parts and \
+                        parts[-1] == "partial":
+                    # @partial(jax.jit, static_argnums=...)
+                    inner = dotted(call.args[0]) if call.args else None
+                    if inner and _is_trace_wrapper(inner):
+                        self._mark(fn, _static_names_from_call(call, fn))
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = dotted(node.func)
+            wrapper = _is_trace_wrapper(parts) if parts else None
+            if wrapper is None:
+                continue
+            for pos in _TRACED_ARG_POS[wrapper]:
+                if pos >= len(node.args):
+                    continue
+                for target in self._resolve_funcs(node.args[pos], node):
+                    self._mark(target,
+                               _static_names_from_call(node, target))
+            if wrapper in ("jit", "pjit"):
+                self._register_jitted_object(node)
+
+    def _resolve_funcs(self, expr: ast.AST, at: ast.AST) -> List[ast.AST]:
+        if isinstance(expr, ast.Lambda):
+            return []          # lambdas: too small to host impurity
+        parts = dotted(expr)
+        if parts is None:
+            return []
+        if len(parts) == 1:
+            hit = self.index.resolve_name(parts[0], at)
+            return [hit] if hit is not None else []
+        return self.index.resolve_attr_method(parts[-1], at)
+
+    def _mark(self, fn: ast.AST, static_names: Set[str]) -> None:
+        if fn in self.traced:
+            self.traced[fn] |= static_names
+            return
+        self.traced[fn] = set(static_names)
+        # transitive: calls + nested defs inside this trace context
+        for node in ast.walk(fn):
+            if isinstance(node, FuncDef) and node is not fn:
+                self._mark(node, set())
+            if isinstance(node, ast.Call):
+                for target in self._resolve_funcs(node.func, node):
+                    if target not in self.traced:
+                        self._mark(target, set())
+
+    def _register_jitted_object(self, call: ast.Call) -> None:
+        """Track ``X = jax.jit(fn, static_argnums=…, donate_argnums=…)``
+        so call sites of ``X`` can be checked (JIT104/JIT105).  Also
+        handles the chained ``a = b = jit(...)`` and the immediate
+        ``jit(fn, ...)(args)`` forms."""
+        static: Set[int] = set()
+        donate: Set[int] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                static.update(_int_elems(kw.value))
+            elif kw.arg == "donate_argnums":
+                donate.update(_int_elems(kw.value))
+        if not static and not donate:
+            return
+        parent = self.parents.get(call)
+        targets: List[ast.AST] = []
+        if isinstance(parent, ast.Assign):
+            targets = list(parent.targets)
+        elif isinstance(parent, (ast.AnnAssign, ast.AugAssign)):
+            targets = [parent.target]
+        elif isinstance(parent, ast.Call) and parent.func is call:
+            # immediate invocation: check this very call site
+            self._check_jitted_call(parent, static, donate)
+            return
+        for t in targets:
+            parts = dotted(t)
+            if parts:
+                self.jitted_objects[parts] = (static, donate)
+
+    # -- rule evaluation ----------------------------------------------
+    def run(self) -> List[Finding]:
+        self.collect_entries()
+        for fn, static_names in self.traced.items():
+            self._lint_traced_body(fn, static_names)
+        self._lint_jitted_call_sites()
+        return self.findings
+
+    def _emit(self, rule: str, severity: str, node: ast.AST,
+              symbol: str, message: str, hint: str = "") -> None:
+        self.findings.append(Finding(
+            rule=rule, severity=severity, path=self.path,
+            line=getattr(node, "lineno", 0), symbol=symbol,
+            message=message, fix_hint=hint))
+
+    def _body_nodes(self, fn: ast.AST):
+        """Walk ``fn`` excluding nested function bodies (each traced
+        nested def is linted as its own context)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            yield n
+            if not isinstance(n, FuncDef + (ast.Lambda,)):
+                stack.extend(ast.iter_child_nodes(n))
+
+    def _lint_traced_body(self, fn: ast.AST, static_names: Set[str]):
+        qn = self.index.qualname[fn]
+        params = {a.arg for a in
+                  fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs}
+        params -= static_names | {"self", "cls"}
+        for node in self._body_nodes(fn):
+            if isinstance(node, ast.Call):
+                self._check_host_call(node, qn)
+            elif isinstance(node, ast.Global):
+                self._emit(
+                    "JIT102", "warning", node, qn,
+                    f"'global {', '.join(node.names)}' inside "
+                    f"jit-traced '{fn.name}' mutates host state at "
+                    "trace time, not per call",
+                    "return the value and thread it through the caller")
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign)):
+                self._check_self_mutation(node, fn, qn)
+            elif isinstance(node, (ast.If, ast.While)):
+                self._check_tracer_branch(node, params, fn, qn)
+
+    def _check_host_call(self, call: ast.Call, qn: str) -> None:
+        parts = dotted(call.func)
+        if parts is None:
+            return
+        name = ".".join(parts)
+        impure = (
+            (parts[0] in _HOST_CALL_ROOTS and len(parts) > 1)
+            or (len(parts) == 1 and parts[0] in _HOST_BUILTINS)
+            or (len(parts) >= 2 and parts[0] in ("np", "numpy")
+                and parts[1] == "random"))
+        if not impure:
+            return
+        self._emit(
+            "JIT101", "error", call, qn,
+            f"host-impure call '{name}' inside a jit-traced function — "
+            "it executes once at trace time and its result is baked "
+            "into the compiled program",
+            "hoist it out of the traced function (pass the value in), "
+            "or use jax.random / jax.debug.print")
+
+    def _check_self_mutation(self, node: ast.AST, fn: ast.AST,
+                             qn: str) -> None:
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            for tt in ast.walk(t):
+                base = tt
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name) and \
+                        base.value.id == "self":
+                    self._emit(
+                        "JIT102", "warning", node, qn,
+                        f"store to self.{base.attr} inside jit-traced "
+                        f"'{fn.name}' happens at trace time (once per "
+                        "compilation), not per call",
+                        "return the new value instead of mutating, or "
+                        "hoist the caching out of the traced function")
+
+    def _check_tracer_branch(self, node: ast.AST, params: Set[str],
+                             fn: ast.AST, qn: str) -> None:
+        if not params:
+            return
+        if isinstance(node, ast.If) and all(
+                isinstance(s, ast.Raise) for s in node.body):
+            # validation guard: raising at trace time is the point
+            return
+        hot = _dynamic_names(node.test)
+        bad = sorted(hot & params)
+        if not bad:
+            return
+        kind = "if" if isinstance(node, ast.If) else "while"
+        self._emit(
+            "JIT103", "warning", node, qn,
+            f"Python '{kind}' on traced parameter(s) "
+            f"{', '.join(bad)} inside '{fn.name}' — branching on a "
+            "tracer fails (or silently specializes when the value is "
+            "concrete at trace time)",
+            "use jnp.where/lax.cond/lax.while_loop, or mark the "
+            "parameter static_argnums")
+
+    # -- call sites of jitted objects (JIT104/JIT105) ------------------
+    def _lint_jitted_call_sites(self) -> None:
+        if not self.jitted_objects:
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = dotted(node.func)
+            if parts is None:
+                continue
+            spec = self.jitted_objects.get(parts)
+            if spec is None and len(parts) > 1:
+                # "self._tick" registered, called as "self._tick" — but
+                # also match a bare local alias of the last component
+                spec = self.jitted_objects.get(parts[-1:])
+            if spec is None:
+                continue
+            static, donate = spec
+            self._check_jitted_call(node, static, donate)
+
+    def _check_jitted_call(self, call: ast.Call, static: Set[int],
+                           donate: Set[int]) -> None:
+        fn = self.index.enclosing_function(call)
+        qn = self.index.qualname.get(fn, "<module>") if fn else "<module>"
+        for pos in static:
+            if pos < len(call.args):
+                arg = call.args[pos]
+                bad = isinstance(arg, (ast.List, ast.Dict, ast.Set,
+                                       ast.ListComp, ast.DictComp,
+                                       ast.SetComp))
+                if not bad and isinstance(arg, ast.Call):
+                    ap = dotted(arg.func)
+                    bad = ap is not None and ap[-1] in ("list", "dict",
+                                                        "set")
+                if bad:
+                    self._emit(
+                        "JIT104", "error", arg, qn,
+                        f"non-hashable value at static_argnums position "
+                        f"{pos} — jit static arguments are dict keys "
+                        "and must be hashable",
+                        "pass a tuple / frozenset, or drop the "
+                        "argument from static_argnums")
+        if donate and fn is not None:
+            self._check_donation_reuse(call, donate, fn, qn)
+
+    def _check_donation_reuse(self, call: ast.Call, donate: Set[int],
+                              fn: ast.AST, qn: str) -> None:
+        donated: Dict[Tuple[str, ...], ast.AST] = {}
+        for pos in donate:
+            if pos < len(call.args):
+                parts = dotted(call.args[pos])
+                if parts is not None:
+                    donated[parts] = call.args[pos]
+        if not donated:
+            return
+        # linear post-order approximation: any LOAD of the donated
+        # dotted path strictly after the call line, before a STORE to
+        # the same path, is a use-after-donate
+        accesses: List[Tuple[int, int, Tuple[str, ...], str]] = []
+        for n in self._body_nodes(fn):
+            if isinstance(n, (ast.Attribute, ast.Name)):
+                parts = dotted(n)
+                if parts in donated:
+                    kind = "store" if isinstance(
+                        n.ctx, (ast.Store, ast.Del)) else "load"
+                    accesses.append((n.lineno, n.col_offset, parts, kind))
+        accesses.sort()
+        end = (call.end_lineno or call.lineno, call.end_col_offset or 0)
+        # the statement the call sits in rebinds its own assignment
+        # targets (`buf = f(buf, x)` is the canonical donation idiom)
+        rebound: Set[Tuple[str, ...]] = set()
+        parent = self.parents.get(call)
+        while parent is not None and not isinstance(parent, ast.stmt):
+            parent = self.parents.get(parent)
+        if isinstance(parent, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = parent.targets if isinstance(parent, ast.Assign) \
+                else [parent.target]
+            for t in targets:
+                for tt in ast.walk(t):
+                    parts = dotted(tt)
+                    if parts:
+                        rebound.add(parts)
+        for lineno, col, parts, kind in accesses:
+            if (lineno, col) <= end:
+                continue
+            if kind == "store":
+                rebound.add(parts)
+            elif parts not in rebound:
+                rebound.add(parts)   # report once per path
+                self._emit(
+                    "JIT105", "warning",
+                    donated[parts], qn,
+                    f"'{'.'.join(parts)}' is donated to a jitted call "
+                    f"(line {call.lineno}) and read again afterwards — "
+                    "the buffer may already be invalidated in place",
+                    "rebind the name to the call's output before any "
+                    "further use (enable DL4J_TPU_SANITIZE=donation "
+                    "to confirm at runtime)")
+
+
+def lint_tree(tree: ast.Module, path: str) -> List[Finding]:
+    return _ModuleLint(tree, path).run()
+
+
+def lint_source(source: str, path: str = "<string>") -> List[Finding]:
+    return lint_tree(ast.parse(source), path)
+
+
+def _dynamic_names(test: ast.AST) -> Set[str]:
+    """Names in a branch test that would read a TRACED value — i.e.
+    excluding shape-derived reads, identity/type checks, attribute
+    reads of config objects, membership tests, and string-equality
+    dispatch, all of which are static under tracing."""
+    out: Set[str] = set()
+    skip: Set[ast.AST] = set()
+    str_dispatched: Set[str] = set()
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            parts = dotted(n.func)
+            if parts and (parts[-1] in _STATIC_CALLS):
+                for sub in ast.walk(n):
+                    skip.add(sub)
+        elif isinstance(n, ast.Attribute):
+            # `cfg.flag` is config plumbing, not a tracer read; only
+            # reducer methods (`x.any()`, …) read traced data
+            if n.attr in _SHAPE_ATTRS or n.attr not in _TRACER_REDUCERS:
+                for sub in ast.walk(n):
+                    skip.add(sub)
+        elif isinstance(n, ast.Compare):
+            if any(isinstance(c, (ast.Is, ast.IsNot)) for c in n.ops):
+                for sub in ast.walk(n):
+                    skip.add(sub)
+            # `kind == "clip"` string dispatch: tracers are never
+            # strings, so the compared name is static everywhere
+            sides = [n.left] + list(n.comparators)
+            if any(isinstance(s, ast.Constant) and
+                   isinstance(s.value, str) for s in sides):
+                for s in sides:
+                    if isinstance(s, ast.Name):
+                        str_dispatched.add(s.id)
+            # `x in needed`: the container is a static host set
+            for op, comp in zip(n.ops, n.comparators):
+                if isinstance(op, (ast.In, ast.NotIn)):
+                    for sub in ast.walk(comp):
+                        skip.add(sub)
+    for n in ast.walk(test):
+        if n in skip:
+            continue
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.add(n.id)
+    return out - str_dispatched

@@ -1,0 +1,211 @@
+"""Runtime sanitizer — the dynamic companion to the static passes.
+
+``DL4J_TPU_SANITIZE=nan,donation`` (or ``all``) turns on opt-in
+runtime confirmation of the two bug classes the static passes flag:
+
+* **nan** — ``jax.debug_nans``-style finite checks at the host
+  boundaries the lint reasons about: the fit loop checks every step's
+  loss, and the decode tick checks the active slots' held logits — the
+  exact surface PR 2's NaN-poisoned-slot bug corrupted.  One device
+  sync per step/tick while enabled; a debug mode, like the solver's
+  ``DL4J_TPU_CHECK_NUMERICS``.
+* **donation** — a use-after-donate guard: buffers passed at
+  ``donate_argnums`` positions are registered as dead, and touching
+  one again (before rebinding to the call's fresh output) raises
+  :class:`SanitizerError` with the donation site — the dynamic mirror
+  of jit_lint's JIT105.
+
+With no modes active every hook is one frozenset-membership check, so
+the call sites stay compiled into production paths (the same honesty
+property as the fault injector: the check traverses exactly the code a
+real run would).
+
+Telemetry: every trip increments
+``sanitizer_trips_total{mode=nan|donation}``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu import telemetry
+
+MODES = ("nan", "donation")
+
+_TRIPS = telemetry.counter(
+    "sanitizer_trips_total",
+    "runtime sanitizer violations detected (raise sites), by mode",
+    labelnames=("mode",))
+
+
+class SanitizerError(RuntimeError):
+    """A runtime sanitizer check failed (non-finite value or
+    use-after-donate)."""
+
+
+def _parse(text: Optional[str]) -> frozenset:
+    if not text:
+        return frozenset()
+    parts = {p.strip().lower() for p in text.split(",") if p.strip()}
+    if "all" in parts:
+        return frozenset(MODES)
+    unknown = parts - set(MODES)
+    if unknown:
+        raise ValueError(
+            f"DL4J_TPU_SANITIZE: unknown mode(s) {sorted(unknown)} "
+            f"(choose from {MODES} or 'all')")
+    return frozenset(parts)
+
+
+def _parse_lenient(text: Optional[str]) -> frozenset:
+    """Import-time parse: a typo in the env var must not make the
+    whole package unimportable — warn and ignore the bad mode.
+    ``refresh()`` (the explicit API) stays strict."""
+    try:
+        return _parse(text)
+    except ValueError as e:
+        import logging
+        logging.getLogger("deeplearning4j_tpu").warning("%s", e)
+        return frozenset(p.strip().lower() for p in (text or "").split(",")
+                         if p.strip().lower() in MODES)
+
+
+_active: frozenset = _parse_lenient(os.environ.get("DL4J_TPU_SANITIZE"))
+
+
+def refresh() -> frozenset:
+    """Re-read ``DL4J_TPU_SANITIZE`` (tests toggle the env mid-process;
+    production reads it once at import)."""
+    global _active
+    _active = _parse(os.environ.get("DL4J_TPU_SANITIZE"))
+    return _active
+
+
+def active(mode: str) -> bool:
+    return mode in _active
+
+
+def enabled() -> frozenset:
+    return _active
+
+
+# ---------------------------------------------------------------------------
+# nan mode
+# ---------------------------------------------------------------------------
+
+def check_finite(site: str, value, detail: str = "") -> None:
+    """Raise :class:`SanitizerError` when any element of ``value``
+    (array-like, or a scalar) is non-finite.  Call only when
+    ``active('nan')`` — the caller gates, so the off path costs one
+    set lookup, not an array pull."""
+    arr = np.asarray(value)
+    if np.issubdtype(arr.dtype, np.floating) and \
+            not np.isfinite(arr).all():
+        _TRIPS.labels(mode="nan").inc()
+        n_bad = int((~np.isfinite(arr)).sum())
+        raise SanitizerError(
+            f"[sanitize:nan] non-finite value at {site}: {n_bad}/"
+            f"{arr.size} elements{' — ' + detail if detail else ''}")
+
+
+def check_finite_rows(site: str, value, row_mask,
+                      detail: str = "") -> None:
+    """Finite check restricted to rows where ``row_mask`` is True —
+    the decode tick's shape: inactive slots legitimately hold stale
+    garbage, only ACTIVE slots' state must stay finite."""
+    arr = np.asarray(value)
+    mask = np.asarray(row_mask, bool)
+    if not mask.any() or not np.issubdtype(arr.dtype, np.floating):
+        return
+    bad_rows = [int(i) for i in np.nonzero(mask)[0]
+                if not np.isfinite(arr[i]).all()]
+    if bad_rows:
+        _TRIPS.labels(mode="nan").inc()
+        raise SanitizerError(
+            f"[sanitize:nan] non-finite values at {site} in active "
+            f"row(s) {bad_rows}"
+            f"{' — ' + detail if detail else ''}")
+
+
+# ---------------------------------------------------------------------------
+# donation mode
+# ---------------------------------------------------------------------------
+
+class _DonationLedger:
+    """Tracks buffers whose storage was donated to a jitted call.
+    Entries hold weakrefs — a garbage-collected buffer cannot be
+    misused, so its entry evaporates."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dead: Dict[int, Tuple[weakref.ref, str]] = {}
+
+    def _sweep_locked(self) -> None:
+        gone = [k for k, (r, _) in self._dead.items() if r() is None]
+        for k in gone:
+            del self._dead[k]
+
+    def mark(self, site: str, *buffers) -> None:
+        """Record every array leaf of ``buffers`` as donated at
+        ``site``.  A later :meth:`check` on the same object raises."""
+        import jax
+        with self._lock:
+            self._sweep_locked()
+            for b in buffers:
+                for leaf in jax.tree_util.tree_leaves(b):
+                    try:
+                        r = weakref.ref(leaf)
+                    except TypeError:
+                        continue
+                    self._dead[id(leaf)] = (r, site)
+
+    def clear(self, *buffers) -> None:
+        """Un-mark (a failed dispatch may leave buffers valid)."""
+        import jax
+        with self._lock:
+            for b in buffers:
+                for leaf in jax.tree_util.tree_leaves(b):
+                    self._dead.pop(id(leaf), None)
+
+    def check(self, use_site: str, *buffers) -> None:
+        """Raise when any array leaf of ``buffers`` was donated."""
+        import jax
+        with self._lock:
+            self._sweep_locked()
+            for b in buffers:
+                for leaf in jax.tree_util.tree_leaves(b):
+                    hit = self._dead.get(id(leaf))
+                    if hit is not None and hit[0]() is leaf:
+                        _TRIPS.labels(mode="donation").inc()
+                        raise SanitizerError(
+                            f"[sanitize:donation] buffer used at "
+                            f"{use_site} was donated at {hit[1]} — "
+                            "its storage may already be overwritten")
+
+    def reset(self) -> None:
+        with self._lock:
+            self._dead.clear()
+
+
+#: process-wide ledger (one donation namespace per process, like the
+#: metrics registry)
+ledger = _DonationLedger()
+
+
+def mark_donated(site: str, *buffers) -> None:
+    if active("donation"):
+        ledger.mark(site, *buffers)
+
+
+def check_not_donated(use_site: str, *buffers) -> None:
+    if active("donation"):
+        ledger.check(use_site, *buffers)
+
+
+def clear_donated(*buffers) -> None:
+    if active("donation"):
+        ledger.clear(*buffers)

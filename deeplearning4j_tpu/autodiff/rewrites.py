@@ -484,17 +484,97 @@ def fuse_gelu(sd: SameDiff) -> int:
         total += len(matches)
 
 
+def rewrite_check_enabled() -> bool:
+    """``DL4J_TPU_REWRITE_CHECK=1``: every rewrite pass in
+    ``optimize_for_tpu`` asserts it preserved the graph's inferred
+    output shapes (and dtypes, when not deliberately re-typing) via
+    ``jax.eval_shape`` — abstract evaluation only, no device memory.
+    Catches the ``fold_flatten_reshapes``-style axis bug class AT
+    REWRITE TIME instead of at numerics-parity time.  A debug mode:
+    one abstract trace per mutating pass (plus one up front — each
+    pass's post-signature is reused as the next pass's baseline)."""
+    import os
+    return os.environ.get("DL4J_TPU_REWRITE_CHECK", "") in ("1", "true")
+
+
+def _shape_signature(sd: SameDiff):
+    """``{terminal_output: (shape, dtype)}`` via abstract evaluation,
+    or None when the graph cannot trace without real feeds (dynamic
+    control flow, unresolvable placeholder shapes) — parity checking
+    is then skipped, not failed."""
+    from deeplearning4j_tpu.analysis.graph_lint import infer_shapes
+    try:
+        return infer_shapes(sd)
+    except Exception:
+        return None
+
+
+def _run_rewrite_pass(sd: SameDiff, tag: str, fn,
+                      check_dtypes: bool = True,
+                      carry: Optional[dict] = None) -> int:
+    """Run one rewrite pass, parity-checked when the debug flag is on.
+    ``carry`` (a dict, shared across a pipeline) caches the signature
+    between passes so each graph state is abstractly traced once."""
+    if not rewrite_check_enabled():
+        return fn()
+    before = carry.get("sig") if carry else None
+    if before is None:
+        before = _shape_signature(sd)
+    n = fn()
+    if not n or before is None:
+        if carry is not None:
+            carry["sig"] = before        # graph unchanged when n == 0
+        return n
+    after = _shape_signature(sd)
+    if carry is not None:
+        carry["sig"] = after
+    if after is None:
+        raise AssertionError(
+            f"rewrite pass '{tag}' broke the graph: it traced before "
+            "the pass but shape inference now fails")
+    bad = []
+    for out, (shape, dtype) in before.items():
+        got = after.get(out)
+        if got is None:
+            bad.append(f"{out}: output disappeared")
+        elif got[0] != shape:
+            bad.append(f"{out}: shape {shape} -> {got[0]}")
+        elif check_dtypes and got[1] != dtype:
+            bad.append(f"{out}: dtype {dtype} -> {got[1]}")
+    if bad:
+        raise AssertionError(
+            f"rewrite pass '{tag}' changed inferred outputs "
+            f"({'; '.join(bad)}) — the rewrite is not "
+            "semantics-preserving")
+    return n
+
+
 def optimize_for_tpu(sd: SameDiff,
                      compute_dtype: Optional[str] = None) -> Dict[str, int]:
     """Run the full imported-graph canonicalization pipeline — the
-    platform-helper seam in one call.  Returns per-pass fusion counts."""
+    platform-helper seam in one call.  Returns per-pass fusion counts.
+
+    With ``DL4J_TPU_REWRITE_CHECK=1`` every pass asserts eval_shape
+    parity on the graph's outputs (see :func:`rewrite_check_enabled`);
+    the attention pass skips the dtype half of the check when
+    ``compute_dtype`` deliberately re-types the fused node."""
+    carry: Dict[str, object] = {}
     return {
-        "parallel_matmuls": fuse_parallel_matmuls(sd),
-        "layer_norm": fuse_layer_norm(sd),
-        "gelu": fuse_gelu(sd),
-        "attention": fuse_attention(sd, compute_dtype=compute_dtype),
+        "parallel_matmuls": _run_rewrite_pass(
+            sd, "parallel_matmuls", lambda: fuse_parallel_matmuls(sd),
+            carry=carry),
+        "layer_norm": _run_rewrite_pass(
+            sd, "layer_norm", lambda: fuse_layer_norm(sd), carry=carry),
+        "gelu": _run_rewrite_pass(sd, "gelu", lambda: fuse_gelu(sd),
+                                  carry=carry),
+        "attention": _run_rewrite_pass(
+            sd, "attention",
+            lambda: fuse_attention(sd, compute_dtype=compute_dtype),
+            check_dtypes=compute_dtype is None, carry=carry),
         # last: operates on the matmuls the passes above left unfused
-        "flatten_reshapes": fold_flatten_reshapes(sd),
+        "flatten_reshapes": _run_rewrite_pass(
+            sd, "flatten_reshapes", lambda: fold_flatten_reshapes(sd),
+            carry=carry),
     }
 
 

@@ -26,6 +26,7 @@ import time
 from typing import Callable, Optional
 
 from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.analysis import sanitize as _sanitize
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience import preemption as _preemption
 from deeplearning4j_tpu.resilience.errors import TrainingPreempted
@@ -176,6 +177,14 @@ def run_fit(model, iterator, n_epochs: int,
                 with tracer.span("train/step",
                                  iteration=model.iteration_count):
                     loss = step_fn(batch)
+                if _sanitize.active("nan"):
+                    # DL4J_TPU_SANITIZE=nan — one device sync per step;
+                    # the opt-in dynamic confirmation of jit_lint's
+                    # NaN findings (the solver's bad-step SELECT keeps
+                    # params clean, but the loss still reports NaN)
+                    _sanitize.check_finite(
+                        "train/loss", loss,
+                        detail=f"iteration {model.iteration_count}")
                 last_loss = loss
                 # batch_in_epoch counts COMPLETED batches and advances
                 # with the batch's LAST chunk, BEFORE listeners fire —

@@ -68,6 +68,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.analysis import sanitize as _sanitize
 from deeplearning4j_tpu.models.generation import (TransformerGenerator,
                                                   _filter_logits)
 from deeplearning4j_tpu.parallel.inference import _bucket
@@ -246,6 +247,13 @@ class GenerationServer:
         self.submit_retries = int(submit_retries)
         self.retry_backoff_s = float(retry_backoff_s)
 
+        # Scheduler state shared with the watchdog: _active/_pending/
+        # _free and the device pool (_kc/_vc/_state) mutate only under
+        # _lock; the epoch token fences a recovered-past scheduler
+        # thread out of every commit point.  The lock exists BEFORE
+        # _fresh_pool — the pool reset is also the watchdog's recovery
+        # path and commits under it (CONC201).
+        self._lock = threading.RLock()
         self._fresh_pool()
         self._ids = np.zeros((self.n_slots, self.max_len),
                              np.int32)                # host output rows
@@ -254,10 +262,6 @@ class GenerationServer:
         self._admit_cache = {}
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue(
             maxsize=queue_limit)
-        # Scheduler state shared with the watchdog: _active/_pending/
-        # _free mutate only under _lock; the epoch token fences a
-        # recovered-past scheduler thread out of every commit point.
-        self._lock = threading.RLock()
         self._active = {}                # slot -> request
         self._pending = []               # admitted-order wait line
         self._free = list(range(self.n_slots - 1, -1, -1))
@@ -296,15 +300,19 @@ class GenerationServer:
         dh = gen.emb.n_out // h
         n_layers = len(gen.blocks)
         cd = gen.compute_dtype
-        self._kc = jnp.zeros((n_layers, B, h, L, dh), cd)
-        self._vc = jnp.zeros((n_layers, B, h, L, dh), cd)
-        self._state = {
+        kc = jnp.zeros((n_layers, B, h, L, dh), cd)
+        vc = jnp.zeros((n_layers, B, h, L, dh), cd)
+        state = {
             "pos": jnp.zeros((B,), jnp.int32),        # next write index
             "remaining": jnp.zeros((B,), jnp.int32),  # tokens to emit
             "eos": jnp.full((B,), -1, jnp.int32),     # -1 disables
             "logits": jnp.zeros((B, self._vocab), jnp.float32),
             "key": jnp.zeros((B, 2), jnp.uint32),     # per-slot PRNG
         }
+        # commit atomically: this also runs on the watchdog's recovery
+        # path while the (fenced) scheduler may still be snapshotting
+        with self._lock:
+            self._kc, self._vc, self._state = kc, vc, state
 
     # -- public API ----------------------------------------------------
     def refresh_params(self):
@@ -330,7 +338,8 @@ class GenerationServer:
     def healthy(self) -> bool:
         """True while the scheduler thread is alive and admission is
         open (the ``server_healthy`` gauge, as a method)."""
-        return (not self._shutdown and self._worker.is_alive())
+        with self._lock:
+            return (not self._shutdown and self._worker.is_alive())
 
     def submit_async(self, prompt_ids, n_new: int,
                      eos_id: Optional[int] = None,
@@ -343,8 +352,9 @@ class GenerationServer:
         bounds the request's total residence — queue wait included;
         past it the request fails with ``DeadlineExceededError`` and
         its slot is reclaimed."""
-        if self._shutdown:
-            raise RuntimeError("GenerationServer has been shut down")
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("GenerationServer has been shut down")
         prompt = np.asarray(prompt_ids, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
             raise ValueError("prompt_ids must be a non-empty 1-D int "
@@ -368,10 +378,14 @@ class GenerationServer:
                 self._queue.put(req, timeout=0.1)
                 break
             except queue.Full:
-                if self._shutdown:   # nobody will ever drain a slot
+                with self._lock:
+                    down = self._shutdown
+                if down:             # nobody will ever drain a slot
                     raise RuntimeError(
                         "GenerationServer has been shut down") from None
-        if self._shutdown and not self._worker.is_alive():
+        with self._lock:
+            dead = self._shutdown and not self._worker.is_alive()
+        if dead:
             # raced shutdown(): the put may have landed AFTER the
             # worker's (and shutdown's) final drains — fail leftovers
             # ourselves so no caller's result() blocks forever
@@ -545,16 +559,24 @@ class GenerationServer:
         padded = np.zeros((1, tb), np.int32)
         padded[0, :req.t0] = req.prompt
         emb_p, blk_stack, head_p = self._params
+        # snapshot the pool atomically: a concurrent watchdog recovery
+        # swaps all three together, and a torn read would scatter this
+        # prefill into a mixed old/new pool
+        with self._lock:
+            kc, vc, state = self._kc, self._vc, self._state
+        _sanitize.check_not_donated("serve/admit", kc, vc, state)
         out = self._admit_fn(tb)(
-            emb_p, blk_stack, head_p, self._kc, self._vc, self._state,
+            emb_p, blk_stack, head_p, kc, vc, state,
             jnp.asarray(padded), np.int32(req.t0), np.int32(slot),
             np.int32(req.n_new), np.int32(req.eos_id),
             jax.random.PRNGKey(req.seed))
+        _sanitize.mark_donated("serve/admit", kc, vc, state)
         with self._lock:
             if self._epoch != my_epoch:
                 return False
             self._kc, self._vc, self._state = out
-        self._ids[slot, :req.t0] = req.prompt
+            # _ids row under the same lock: _retire copies from it
+            self._ids[slot, :req.t0] = req.prompt
         _ADMITTED.inc()
         return True
 
@@ -562,7 +584,9 @@ class GenerationServer:
         if error is not None:
             req._error = error
         else:
-            req._result = self._ids[slot, :req.t0 + req.emitted].copy()
+            with self._lock:
+                req._result = self._ids[slot,
+                                        :req.t0 + req.emitted].copy()
             dt = time.perf_counter() - req.t_submit
             if dt > 0:
                 _RATE.observe(req.emitted / dt)
@@ -596,6 +620,12 @@ class GenerationServer:
                     "generation request deadline elapsed before "
                     "completion"))
 
+    def _superseded(self, my_epoch: int) -> bool:
+        """True when a watchdog recovery bumped the epoch past this
+        scheduler (locked read — the fence must not be torn)."""
+        with self._lock:
+            return self._epoch != my_epoch
+
     def _mark_tick(self, my_epoch: int, value) -> None:
         """Set/clear the in-flight dispatch timestamp, but only while
         this scheduler still owns the epoch — a superseded thread must
@@ -628,7 +658,7 @@ class GenerationServer:
             # ingest: block only when idle, else drain without waiting
             if idle and not stop:
                 item = self._queue.get()
-                if self._epoch != my_epoch:
+                if self._superseded(my_epoch):
                     # recovered past us while we slept: hand the item
                     # to the live scheduler (sentinels included)
                     self._queue.put(item)
@@ -643,7 +673,7 @@ class GenerationServer:
                     item = self._queue.get_nowait()
                 except queue.Empty:
                     break
-                if self._epoch != my_epoch:
+                if self._superseded(my_epoch):
                     self._queue.put(item)
                     return
                 if item is None:
@@ -656,7 +686,9 @@ class GenerationServer:
             # entirely — the watchdog must notice the corpse, fail the
             # in-flight requests and restart the scheduler
             _faults.maybe_fail("serve_tick_fail")
-            if stop and not self._drain:
+            with self._lock:
+                drain = self._drain
+            if stop and not drain:
                 self._fail_all_in_flight(
                     RuntimeError("GenerationServer shut down with the "
                                  "request in flight"))
@@ -705,15 +737,36 @@ class GenerationServer:
                     # here past tick_timeout_s and the watchdog takes
                     # over; on wake the epoch check fences us out
                     _faults.maybe_stall("serve_tick_stall")
+                    # snapshot the pool atomically under the epoch
+                    # check — a concurrent recovery swaps all three
+                    # together, and a torn read would tick a mixed
+                    # old/new pool
                     with self._lock:
                         if self._epoch != my_epoch:
                             return
+                        kc_in, vc_in, state_in = (self._kc, self._vc,
+                                                  self._state)
+                    _sanitize.check_not_donated("serve/tick", kc_in,
+                                                vc_in, state_in)
                     kc, vc, state, tok = self._tick(
-                        emb_p, blk_stack, head_p, self._kc, self._vc,
-                        self._state)
+                        emb_p, blk_stack, head_p, kc_in, vc_in,
+                        state_in)
+                    _sanitize.mark_donated("serve/tick", kc_in, vc_in,
+                                           state_in)
                     tok_h = np.asarray(tok)
                     rem_h = np.asarray(state["remaining"])
                     self._mark_tick(my_epoch, None)
+                if _sanitize.active("nan"):
+                    # the decode-tick finite check (the PR 2 poisoned-
+                    # slot bug class): only ACTIVE slots' held logits
+                    # must be finite — free slots park stale garbage
+                    with self._lock:
+                        mask = np.zeros((self.n_slots,), bool)
+                        for s in self._active:
+                            mask[s] = True
+                    _sanitize.check_finite_rows(
+                        "serve/tick logits", np.asarray(state["logits"]),
+                        mask, detail="slot KV cache poisoned?")
                 _TICKS.inc()
                 _OCC.observe(n_active / self.n_slots)
                 now_p = time.perf_counter()

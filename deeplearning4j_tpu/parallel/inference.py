@@ -91,7 +91,9 @@ class ParallelInference:
             maxsize=queue_limit)
         self._apply = jax.jit(model._forward_infer)
         self._worker = threading.Thread(target=self._run, daemon=True)
-        self._shutdown = False
+        # an Event, not a bare bool: shutdown() flips it from the
+        # caller's thread while output() reads it from others (CONC204)
+        self._stop = threading.Event()
         self._worker.start()
 
     def output(self, x, timeout: Optional[float] = None) -> np.ndarray:
@@ -103,7 +105,7 @@ class ParallelInference:
         it.  With ``shed_on_full=True`` a full queue rejects instead of
         blocking the caller (``inference_shed_total``) — backpressure a
         load balancer can see instead of silent latency."""
-        if self._shutdown:
+        if self._stop.is_set():
             raise RuntimeError("ParallelInference has been shut down")
         req = _Request(np.asarray(x))
         t0 = time.perf_counter()
@@ -129,7 +131,7 @@ class ParallelInference:
         return req.result
 
     def shutdown(self):
-        self._shutdown = True
+        self._stop.set()
         self._queue.put(None)
         self._worker.join(timeout=5)
 

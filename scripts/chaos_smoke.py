@@ -179,6 +179,19 @@ def main() -> int:
     if counter("serve_watchdog_restarts_total").value - wd0 < 2:
         problems.append("expected >= 2 watchdog restarts (crash + stall)")
 
+    # -- sanitizer: one deliberate nan trip so the series has a
+    # labeled child on the wire (check_finite itself is unconditional
+    # — DL4J_TPU_SANITIZE gates the CALL SITES, not the check) -------
+    from deeplearning4j_tpu.analysis import SanitizerError, sanitize
+    try:
+        sanitize.check_finite("chaos/probe", float("nan"))
+        problems.append("sanitizer did not trip on NaN")
+    except SanitizerError:
+        pass
+
+    # -- static analysis: lint series on the wire ----------------------
+    ct.emit_analysis_series(problems)
+
     # -- every kind fired (preempt twice: matrix + bit-identical run) --
     expected = {k: 1 for k in resilience.FAULT_KINDS}
     expected["preempt"] = 2
@@ -194,6 +207,8 @@ def main() -> int:
     required += [f'faults_injected_total{{kind="{k}"}}'
                  for k in resilience.FAULT_KINDS]
     required += ["retry_attempts_bucket", "retry_backoff_seconds_bucket"]
+    required += ct.ANALYSIS_SERIES
+    required += ['sanitizer_trips_total{mode="nan"}']
     problems += ct.missing_series(body, required)
 
     print(json.dumps({"ok": not problems, "problems": problems}))

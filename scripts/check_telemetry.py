@@ -50,6 +50,39 @@ RESILIENCE_SERIES = [
     "generation_server_cancelled_total",
 ]
 
+# Static-analysis subsystem series: the lint counter gets labeled
+# children from emit_analysis_series() below; sanitizer_trips_total is
+# registered by importing the training stack (its HELP/TYPE lines are
+# always on the wire; chaos_smoke additionally fires a real trip).
+ANALYSIS_SERIES = [
+    'lint_findings_total{rule="JIT101",severity="error"}',
+    "sanitizer_trips_total",
+]
+
+# one deliberate trace-safety violation — linting it populates
+# lint_findings_total{rule=,severity=} without walking the whole tree
+ANALYSIS_FIXTURE = (
+    "import time\n"
+    "import jax\n"
+    "@jax.jit\n"
+    "def f(x):\n"
+    "    t = time.time()\n"
+    "    return x * t\n")
+
+
+def emit_analysis_series(problems) -> None:
+    """Lint the known-bad fixture and count the findings into the
+    process registry (the CLI's --telemetry hook, in-process) — shared
+    with chaos_smoke so both reports cover the analysis subsystem."""
+    from deeplearning4j_tpu.analysis import jit_lint
+    from deeplearning4j_tpu.analysis.cli import emit_telemetry
+    findings = jit_lint.lint_source(ANALYSIS_FIXTURE, "<fixture>")
+    if not any(f.rule == "JIT101" for f in findings):
+        problems.append(
+            "analysis fixture produced no JIT101 finding "
+            f"(got {[f.rule for f in findings]})")
+    emit_telemetry(findings)
+
 
 def scrape_body(telemetry, registry) -> str:
     """Serve one scrape over a real HTTP endpoint and return the
@@ -154,6 +187,9 @@ def main() -> int:
         problems.append(f"generation_server_retired_total grew "
                         f"{retired.value - retired_before} != 3")
 
+    # -- static analysis: lint series on the wire ----------------------
+    emit_analysis_series(problems)
+
     # -- scrape over HTTP ----------------------------------------------
     body = scrape_body(telemetry, registry)
 
@@ -180,7 +216,7 @@ def main() -> int:
         "generation_server_slots_busy",
         "generation_server_slot_occupancy_bucket",
         "generation_server_ticks_total",
-    ] + RESILIENCE_SERIES
+    ] + RESILIENCE_SERIES + ANALYSIS_SERIES
     problems += missing_series(body, required)
     if lat.count - lat_before != 16:
         problems.append(

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Static-analysis CI gate.
+
+Runs ``deeplearning4j_tpu.analysis`` over the package, diffs the
+findings against the checked-in ``ANALYSIS_BASELINE.json``, and:
+
+* exits 0 when every finding is covered by the baseline (stale keys —
+  fixed debt — are reported but do not fail);
+* exits 1 on any NEW finding, printing a diff-style report
+  (``+`` new finding, ``-`` stale baseline key);
+* ``--update-baseline`` rewrites the baseline to match the current
+  findings (preserving the justifications of surviving keys — fill in
+  a justification for every new entry before committing!) and exits 0.
+
+Wired alongside ``check_telemetry.py`` / ``chaos_smoke.py``:
+
+    JAX_PLATFORMS=cpu python scripts/lint_gate.py
+    JAX_PLATFORMS=cpu python scripts/lint_gate.py --update-baseline
+
+The lint is pure AST walking — nothing in the linted tree is imported
+or executed, so the gate is safe to run on broken work-in-progress
+trees (a file that does not parse is itself a finding).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "ANALYSIS_BASELINE.json")
+DEFAULT_PATHS = [os.path.join(REPO, "deeplearning4j_tpu")]
+
+
+def main(argv=None) -> int:
+    from deeplearning4j_tpu.analysis.cli import emit_telemetry, lint_paths
+    from deeplearning4j_tpu.analysis.findings import Baseline
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="count findings into the metrics registry")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or DEFAULT_PATHS
+    findings = lint_paths(paths, root=REPO)
+    if args.telemetry:
+        emit_telemetry(findings)
+
+    if args.update_baseline:
+        old = Baseline.load(args.baseline) if \
+            os.path.exists(args.baseline) else Baseline()
+        new_bl = old.updated_with(findings)
+        new_bl.save(args.baseline)
+        missing = [k for k, v in new_bl.entries.items()
+                   if not v["justification"]]
+        print(f"baseline updated: {len(new_bl.entries)} key(s) -> "
+              f"{args.baseline}")
+        if missing:
+            print(f"!! {len(missing)} entr(y/ies) lack a justification "
+                  "— fill them in before committing:")
+            for k in missing:
+                print(f"   {k}")
+        return 0
+
+    if not os.path.exists(args.baseline):
+        print(f"no baseline at {args.baseline}; every finding is new "
+              "(create one with --update-baseline)")
+        baseline = Baseline()
+    else:
+        baseline = Baseline.load(args.baseline)
+    new, baselined, stale = baseline.diff(findings)
+
+    for f in new:
+        print(f"+ {f.render()}")
+    for k in stale:
+        print(f"- [stale baseline key] {k}")
+    print(f"== lint gate: {len(findings)} finding(s), "
+          f"{len(baselined)} baselined, {len(new)} NEW, "
+          f"{len(stale)} stale")
+    if new:
+        print("FAIL: new findings — fix them, or (with a written "
+              "justification) add them via --update-baseline")
+        return 1
+    if stale:
+        print("note: stale keys are fixed debt; prune with "
+              "--update-baseline")
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,619 @@
+"""Static-analysis subsystem tests: one positive + one negative fixture
+per rule, the baseline/gate workflow, the runtime sanitizer, and the
+rewrite shape-parity check.  Everything here is AST walking or tiny
+abstract evaluation — CPU-only and fast; the whole-package lint run is
+the only multi-second case and stays lean (in-process, no subprocess).
+"""
+import importlib.util
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import (Baseline, Finding,
+                                         SanitizerError, sanitize)
+from deeplearning4j_tpu.analysis import concurrency_lint, graph_lint
+from deeplearning4j_tpu.analysis import jit_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules(findings):
+    return {f.rule for f in findings}
+
+
+def lint_jit(src):
+    return jit_lint.lint_source(textwrap.dedent(src))
+
+
+def lint_conc(src):
+    return concurrency_lint.lint_source(textwrap.dedent(src))
+
+
+# ---------------------------------------------------------------------------
+# jit_lint
+# ---------------------------------------------------------------------------
+
+class TestJitLint:
+    def test_host_call_in_decorated_jit(self):
+        fs = lint_jit("""
+            import time, jax
+            @jax.jit
+            def f(x):
+                t = time.time()
+                return x * t
+        """)
+        assert "JIT101" in rules(fs)
+        (f,) = [f for f in fs if f.rule == "JIT101"]
+        assert f.severity == "error" and "time.time" in f.message
+
+    def test_host_call_outside_trace_is_clean(self):
+        fs = lint_jit("""
+            import time
+            def f(x):
+                return x * time.time()
+        """)
+        assert not fs
+
+    def test_jax_random_is_not_host_random(self):
+        fs = lint_jit("""
+            import jax
+            @jax.jit
+            def f(key, x):
+                return x + jax.random.normal(key, x.shape)
+        """)
+        assert "JIT101" not in rules(fs)
+
+    def test_call_site_and_transitive_closure(self):
+        # the repo idiom: nested def handed to jax.jit(fn, ...), which
+        # calls a module helper that prints — flagged transitively
+        fs = lint_jit("""
+            import jax
+
+            def helper(x):
+                print("tracing", x)
+                return x
+
+            def build():
+                def tick(state):
+                    return helper(state) + 1
+                return jax.jit(tick)
+        """)
+        hits = [f for f in fs if f.rule == "JIT101"]
+        assert hits and hits[0].symbol == "helper"
+
+    def test_self_mutation_and_global(self):
+        fs = lint_jit("""
+            import jax
+            class M:
+                def build(self):
+                    def step(s, x):
+                        global N
+                        N = 1
+                        self.cache = x
+                        return x
+                    return jax.jit(step)
+        """)
+        assert sum(f.rule == "JIT102" for f in fs) == 2
+
+    def test_tracer_branch_positive(self):
+        fs = lint_jit("""
+            import jax
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+        assert "JIT103" in rules(fs)
+
+    def test_tracer_branch_static_forms_are_clean(self):
+        fs = lint_jit("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, static_argnums=(1,))
+            def f(x, mode, y=None, cfg=None):
+                if mode:                       # static_argnums
+                    x = x + 1
+                if y is None:                  # identity check
+                    x = x + 1
+                if x.ndim == 2:                # shape-derived
+                    x = x + 1
+                if cfg == "fast":              # string dispatch
+                    x = x + 1
+                if x.shape[0] % 8:             # validation guard
+                    raise ValueError("bad")
+                return x
+        """)
+        assert "JIT103" not in rules(fs)
+
+    def test_static_argnums_unhashable_call_site(self):
+        fs = lint_jit("""
+            import jax
+            def g(x, shape):
+                return x.reshape(shape)
+            f = jax.jit(g, static_argnums=(1,))
+            def run(x):
+                return f(x, [4, 4])
+        """)
+        assert "JIT104" in rules(fs)
+        clean = lint_jit("""
+            import jax
+            def g(x, shape):
+                return x.reshape(shape)
+            f = jax.jit(g, static_argnums=(1,))
+            def run(x):
+                return f(x, (4, 4))
+        """)
+        assert "JIT104" not in rules(clean)
+
+    def test_donated_buffer_reuse(self):
+        fs = lint_jit("""
+            import jax
+            def g(buf, x):
+                return buf + x
+            f = jax.jit(g, donate_argnums=(0,))
+            def run(buf, x):
+                y = f(buf, x)
+                return buf + y        # buf's storage is gone
+        """)
+        assert "JIT105" in rules(fs)
+        clean = lint_jit("""
+            import jax
+            def g(buf, x):
+                return buf + x
+            f = jax.jit(g, donate_argnums=(0,))
+            def run(buf, x):
+                buf = f(buf, x)       # rebound: no reuse
+                return buf + 1
+        """)
+        assert "JIT105" not in rules(clean)
+
+
+# ---------------------------------------------------------------------------
+# concurrency_lint
+# ---------------------------------------------------------------------------
+
+_SERVER_PREAMBLE = """
+    import threading
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._worker = threading.Thread(target=self._run)
+            self._worker.start()
+"""
+
+
+class TestConcurrencyLint:
+    def test_unguarded_write_is_error(self):
+        fs = lint_conc(_SERVER_PREAMBLE + """
+        def _run(self):
+            with self._lock:
+                self._n += 1
+            self._n = 0            # write outside the lock
+        """)
+        assert any(f.rule == "CONC201" and f.severity == "error"
+                   for f in fs)
+
+    def test_guarded_write_is_clean(self):
+        fs = lint_conc(_SERVER_PREAMBLE + """
+        def _run(self):
+            with self._lock:
+                self._n += 1
+        """)
+        assert not fs
+
+    def test_unguarded_read_is_warning(self):
+        fs = lint_conc(_SERVER_PREAMBLE + """
+        def _run(self):
+            with self._lock:
+                self._n += 1
+
+        def peek(self):
+            return self._n         # read outside the lock
+        """)
+        assert any(f.rule == "CONC202" and f.severity == "warning"
+                   for f in fs)
+
+    def test_init_is_exempt(self):
+        # the __init__ stores in the preamble never fire CONC201
+        fs = lint_conc(_SERVER_PREAMBLE + """
+        def _run(self):
+            with self._lock:
+                self._n += 1
+        """)
+        assert "CONC201" not in rules(fs)
+
+    def test_locked_suffix_discipline(self):
+        fs = lint_conc(_SERVER_PREAMBLE + """
+        def _reap_locked(self):
+            self._n = 0            # exempt: caller holds the lock
+
+        def _run(self):
+            self._reap_locked()    # ...but this caller does not
+        """)
+        assert any(f.rule == "CONC203" for f in fs)
+        clean = lint_conc(_SERVER_PREAMBLE + """
+        def _reap_locked(self):
+            self._n = 0
+
+        def _run(self):
+            with self._lock:
+                self._reap_locked()
+        """)
+        assert "CONC203" not in rules(clean)
+
+    def test_lockfree_shared_flag(self):
+        fs = lint_conc("""
+            import threading
+            class P:
+                def __init__(self):
+                    self._down = False
+                    self._w = threading.Thread(target=self._run)
+
+                def _run(self):
+                    pass
+
+                def output(self):
+                    if self._down:
+                        raise RuntimeError
+
+                def shutdown(self):
+                    self._down = True
+        """)
+        assert any(f.rule == "CONC204" for f in fs)
+
+    def test_event_flag_is_clean(self):
+        fs = lint_conc("""
+            import threading
+            class P:
+                def __init__(self):
+                    self._stop = threading.Event()
+                    self._w = threading.Thread(target=self._run)
+
+                def _run(self):
+                    pass
+
+                def output(self):
+                    if self._stop.is_set():
+                        raise RuntimeError
+
+                def shutdown(self):
+                    self._stop.set()
+        """)
+        assert not fs
+
+    def test_base_class_methods_fold_in(self):
+        # subclass entry reaches a base-class method's unguarded read
+        fs = lint_conc("""
+            import threading
+            class Base:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._m = {}
+
+                def _get(self):
+                    return self._m[()]     # outside the lock
+
+                def _put(self, k, v):
+                    with self._lock:
+                        self._m[k] = v
+
+            class Child(Base):
+                def inc(self):
+                    return self._get()
+        """)
+        assert any(f.rule == "CONC202" and f.symbol == "Child._get"
+                   for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# graph_lint
+# ---------------------------------------------------------------------------
+
+def _mk_sd():
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(2, 4), dtype="float32")
+    w = sd.var("w", np.ones((4, 3), np.float32))
+    y = sd.op("matmul", x, w)
+    sd.outputs = [y.name]
+    return sd, x, w, y
+
+
+class TestGraphLint:
+    def test_clean_graph(self):
+        sd, *_ = _mk_sd()
+        assert graph_lint.lint_samediff(sd) == []
+
+    def test_dead_vertex(self):
+        sd, x, w, y = _mk_sd()
+        sd.op("relu", x)           # output never consumed / designated
+        fs = graph_lint.lint_samediff(sd)
+        assert any(f.rule == "GRAPH302" for f in fs)
+
+    def test_dangling_input(self):
+        from deeplearning4j_tpu.autodiff.samediff import OpNode
+        sd, x, w, y = _mk_sd()
+        sd.ops.append(OpNode("relu", ["nope"], [y.name + "_r"], {}))
+        sd.vars[y.name + "_r"] = sd.vars[y.name]
+        sd.outputs = [y.name + "_r"]
+        fs = graph_lint.lint_samediff(sd)
+        assert any(f.rule == "GRAPH301" and f.severity == "error"
+                   for f in fs)
+
+    def test_arity_mismatch(self):
+        sd, x, w, y = _mk_sd()
+        sd.ops[0].inputs = [x.name]          # matmul with one input
+        fs = graph_lint.lint_samediff(sd)
+        assert any(f.rule == "GRAPH303" for f in fs)
+
+    def test_f64_constant_from_python_scalar(self):
+        # a TRUE POSITIVE on the real repo API: SDVariable arithmetic
+        # promotes bare Python floats through _as_var/np.asarray into
+        # float64 CONSTANTs
+        sd, x, w, y = _mk_sd()
+        z = y + 1.5
+        sd.outputs = [z.name]
+        fs = graph_lint.lint_samediff(sd, infer=False)
+        assert any(f.rule == "GRAPH304" for f in fs)
+
+    def test_shape_inference_shapes_and_failure(self):
+        sd, x, w, y = _mk_sd()
+        shapes = graph_lint.infer_shapes(sd)
+        assert shapes[y.name] == ((2, 3), "float32")
+        # break the contraction: eval_shape must raise -> GRAPH305
+        sd.vars["x"].shape = (2, 5)
+        fs = graph_lint.lint_samediff(sd)
+        assert any(f.rule == "GRAPH305" for f in fs)
+
+    def test_probe_dim_for_unknown_batch(self):
+        sd, x, w, y = _mk_sd()
+        sd.vars["x"].shape = (None, 4)
+        shapes = graph_lint.infer_shapes(sd)
+        assert shapes[y.name] == ((graph_lint.PROBE_DIM, 3), "float32")
+
+    def test_computation_graph_dead_vertex(self):
+        from deeplearning4j_tpu import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                            OutputLayer)
+        conf = (NeuralNetConfiguration.builder().graph()
+                .add_inputs("in")
+                .add_layer("h", DenseLayer(n_in=4, n_out=8), "in")
+                .add_layer("dead", DenseLayer(n_in=4, n_out=2), "in")
+                .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                              activation="softmax",
+                                              loss="mcxent"), "h")
+                .set_outputs("out")
+                .build())
+        fs = graph_lint.lint_computation_graph(conf)
+        assert any(f.rule == "GRAPH302" and f.symbol == "dead"
+                   for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# findings / baseline / gate
+# ---------------------------------------------------------------------------
+
+def _f(rule="JIT101", path="a.py", symbol="f", message="m",
+       severity="error", line=3):
+    return Finding(rule=rule, severity=severity, path=path, line=line,
+                   symbol=symbol, message=message)
+
+
+class TestBaselineAndGate:
+    def test_keys_ignore_lines_and_track_counts(self):
+        bl = Baseline().updated_with([_f(line=3), _f(line=9),
+                                      _f(symbol="g")])
+        assert bl.entries[_f().key]["count"] == 2
+        new, base, stale = bl.diff([_f(line=30), _f(line=90),
+                                    _f(symbol="g", line=1)])
+        assert not new and len(base) == 3 and not stale
+        # a third occurrence of the same key IS new
+        new, _, _ = bl.diff([_f(), _f(), _f(), _f(symbol="g")])
+        assert len(new) == 1
+
+    def test_stale_keys_detected_and_pruned(self):
+        bl = Baseline().updated_with([_f(), _f(symbol="gone")])
+        new, base, stale = bl.diff([_f()])
+        assert not new and len(stale) == 1
+        pruned = bl.updated_with([_f()])
+        assert list(pruned.entries) == [_f().key]
+
+    def test_update_preserves_justifications(self):
+        bl = Baseline().updated_with([_f()])
+        bl.entries[_f().key]["justification"] = "because"
+        again = bl.updated_with([_f(), _f(symbol="g")])
+        assert again.entries[_f().key]["justification"] == "because"
+        assert again.entries[_f(symbol="g").key]["justification"] == ""
+
+    def test_lint_gate_fails_on_seeded_violation(self, tmp_path):
+        spec = importlib.util.spec_from_file_location(
+            "lint_gate", os.path.join(REPO, "scripts", "lint_gate.py"))
+        gate = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(gate)
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import time, jax
+            @jax.jit
+            def f(x):
+                return x * time.time()
+        """))
+        baseline = tmp_path / "bl.json"
+        # no baseline: the violation is new -> gate fails
+        assert gate.main([str(bad), "--baseline", str(baseline)]) == 1
+        # accept it into the baseline -> gate passes
+        assert gate.main([str(bad), "--baseline", str(baseline),
+                          "--update-baseline"]) == 0
+        assert gate.main([str(bad), "--baseline", str(baseline)]) == 0
+        doc = json.loads(baseline.read_text())
+        assert any("JIT101" in e["key"] for e in doc["entries"])
+        # fixing the violation leaves only a stale key -> still passes
+        bad.write_text("def f(x):\n    return x\n")
+        assert gate.main([str(bad), "--baseline", str(baseline)]) == 0
+
+    @pytest.mark.slow
+    def test_package_lints_clean_against_checked_in_baseline(self):
+        # the acceptance bar, in-process (the CLI equivalent:
+        # python -m deeplearning4j_tpu.analysis --format=json
+        #   --baseline=ANALYSIS_BASELINE.json deeplearning4j_tpu/)
+        from deeplearning4j_tpu.analysis.cli import lint_paths
+        findings = lint_paths(
+            [os.path.join(REPO, "deeplearning4j_tpu")], root=REPO)
+        bl = Baseline.load(os.path.join(REPO, "ANALYSIS_BASELINE.json"))
+        new, baselined, _ = bl.diff(findings)
+        assert not new, [f.render() for f in new]
+        assert not any(f.severity == "error" for f in baselined), \
+            "error-severity findings must be fixed, not baselined"
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def san_env(monkeypatch):
+    def set_modes(modes):
+        monkeypatch.setenv("DL4J_TPU_SANITIZE", modes)
+        sanitize.refresh()
+    yield set_modes
+    monkeypatch.delenv("DL4J_TPU_SANITIZE", raising=False)
+    sanitize.refresh()
+    sanitize.ledger.reset()
+
+
+class TestSanitizer:
+    def test_off_by_default(self, san_env):
+        sanitize.refresh()
+        assert not sanitize.enabled()
+        # hooks are no-ops when off
+        sanitize.check_not_donated("x", np.ones(3))
+        sanitize.mark_donated("x", np.ones(3))
+
+    def test_unknown_mode_rejected(self, san_env):
+        with pytest.raises(ValueError):
+            san_env("nan,bogus")
+
+    def test_nan_check(self, san_env):
+        san_env("nan")
+        sanitize.check_finite("ok", np.ones(4))
+        with pytest.raises(SanitizerError, match="train/loss"):
+            sanitize.check_finite("train/loss", float("nan"))
+
+    def test_nan_rows_masked(self, san_env):
+        san_env("nan")
+        arr = np.ones((3, 4), np.float32)
+        arr[1] = np.nan
+        # poisoned row inactive: fine
+        sanitize.check_finite_rows("tick", arr, [True, False, True])
+        with pytest.raises(SanitizerError, match=r"row\(s\) \[1\]"):
+            sanitize.check_finite_rows("tick", arr, [False, True, False])
+
+    def test_donation_guard(self, san_env):
+        import jax.numpy as jnp
+        san_env("donation")
+        buf = jnp.ones((4,))
+        sanitize.check_not_donated("use", buf)     # not donated yet
+        sanitize.mark_donated("site-A", buf)
+        with pytest.raises(SanitizerError, match="site-A"):
+            sanitize.check_not_donated("use", buf)
+        sanitize.clear_donated(buf)
+        sanitize.check_not_donated("use", buf)
+
+    def test_fit_loop_nan_trips(self, san_env):
+        # e2e: injected NaN batch -> the fit-loop hook raises (the
+        # solver's bad-step SELECT protects params, loss reports NaN)
+        from deeplearning4j_tpu import (MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterator import ListDataSetIterator
+        from deeplearning4j_tpu.nn.conf.layers_core import OutputLayer
+        from deeplearning4j_tpu.resilience import FaultInjector
+
+        conf = (NeuralNetConfiguration.builder().seed(3).list()
+                .layer(OutputLayer(n_in=4, n_out=2,
+                                   activation="softmax", loss="mcxent"))
+                .build())
+        m = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1] * 4]
+        it = ListDataSetIterator(DataSet(x, y).batch_by(4))
+        san_env("nan")
+        with FaultInjector(["nan_loss@1"]):
+            with pytest.raises(SanitizerError, match="iteration 1"):
+                m.fit(it, n_epochs=1, async_prefetch=False)
+
+
+# ---------------------------------------------------------------------------
+# rewrite shape-parity check (DL4J_TPU_REWRITE_CHECK)
+# ---------------------------------------------------------------------------
+
+class TestRewriteCheck:
+    def test_parity_passes_and_catches_breakage(self, monkeypatch):
+        from deeplearning4j_tpu.autodiff import rewrites
+
+        monkeypatch.setenv("DL4J_TPU_REWRITE_CHECK", "1")
+        sd, x, w, y = _mk_sd()
+
+        # a semantics-preserving "pass" (no structural change)
+        assert rewrites._run_rewrite_pass(sd, "noop", lambda: 1) == 1
+
+        # a buggy pass: silently re-type the matmul to bfloat16
+        # (f64 would be invisible — x64-off jax downcasts it anyway)
+        import jax.numpy as jnp
+
+        def bad_pass():
+            sd.vars["x"].dtype = "bfloat16"
+            sd.values["w"] = np.asarray(
+                jnp.asarray(sd.values["w"], jnp.bfloat16))
+            return 1
+
+        with pytest.raises(AssertionError, match="bad_dtype"):
+            rewrites._run_rewrite_pass(sd, "bad_dtype", bad_pass)
+
+        # a buggy pass: change an output's shape
+        sd2, x2, w2, y2 = _mk_sd()
+
+        def bad_shape():
+            sd2.values["w"] = np.ones((4, 7), np.float32)
+            return 1
+
+        with pytest.raises(AssertionError, match="bad_shape"):
+            rewrites._run_rewrite_pass(sd2, "bad_shape", bad_shape)
+
+    def test_disabled_by_default(self, monkeypatch):
+        from deeplearning4j_tpu.autodiff import rewrites
+        monkeypatch.delenv("DL4J_TPU_REWRITE_CHECK", raising=False)
+        sd, *_ = _mk_sd()
+
+        def bad_pass():
+            sd.values["w"] = np.ones((4, 7), np.float32)
+            return 1
+
+        # no check -> no raise (production default: zero cost)
+        assert rewrites._run_rewrite_pass(sd, "x", bad_pass) == 1
+
+    def test_optimize_for_tpu_runs_checked(self, monkeypatch):
+        # the real pipeline under the flag on a graph the passes
+        # actually rewrite (parallel q/k/v matmuls over one input)
+        from deeplearning4j_tpu.autodiff import rewrites
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        monkeypatch.setenv("DL4J_TPU_REWRITE_CHECK", "1")
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(2, 8), dtype="float32")
+        rng = np.random.default_rng(0)
+        outs = []
+        for n in "qkv":
+            w = sd.var(n, rng.normal(size=(8, 8)).astype(np.float32))
+            outs.append(sd.op("matmul", x, w))
+        s = sd.op("add", sd.op("add", outs[0], outs[1]), outs[2])
+        sd.outputs = [s.name]
+        before = graph_lint.infer_shapes(sd)
+        counts = rewrites.optimize_for_tpu(sd)
+        assert counts["parallel_matmuls"] == 1
+        assert graph_lint.infer_shapes(sd) == before
