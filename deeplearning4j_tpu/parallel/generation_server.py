@@ -14,9 +14,19 @@ mid-flight instead of waiting for the whole batch.
 Design:
 
 * the decode tick is ONE static-shape XLA program: per-slot
-  position / remaining-budget / EOS-id live in device-side int32 state,
-  sampling masks inactive slots, and cache writes land at per-slot
-  positions (``_block_decode_step``'s vector-``pos`` path);
+  position / remaining-budget / EOS-id / sampling params live in
+  device-side state, sampling masks inactive slots, and cache writes
+  land at per-slot positions (``_block_decode_step``'s vector-``pos``
+  path);
+* the scheduler fuses up to ``tick_batch`` ticks into ONE device-side
+  ``lax.scan`` (``_decode_scan``): sampled tokens stage in a [B, K]
+  device buffer and the host polls ONCE per scan instead of once per
+  token — per-token dispatch overhead and the device->host sync drop
+  by ~K.  The scan length adapts: K=1 whenever admission is pending
+  (TTFT does not regress behind a long scan) and the largest
+  power-of-two <= the longest live budget otherwise (trailing ticks
+  drain exactly; retired/EOS slots inside a scan tick masked at pos 0,
+  preserving the poisoned-slot invariant below);
 * between ticks the host scheduler admits queued requests into free
   slots — prefill runs the existing batched causal forward
   (``_block_prefill`` scanned over the stacked block params) with the
@@ -45,14 +55,23 @@ work before exiting.  ``server_healthy`` /
 
 Greedy decode through the server is byte-identical to offline
 ``TransformerGenerator.generate()`` per request — the tick runs the
-same stacked-params layer scan.  Sampling (``temperature``/``top_k``/
-``top_p`` are server-level knobs) draws from per-slot PRNG streams, so
-sampled outputs are reproducible per (seed, admission) but do not
+same stacked-params layer scan, at every scan length.  Sampling is
+PER REQUEST (``submit(..., sampling={"temperature": .., "top_k": ..,
+"seed": ..})``; the constructor's ``temperature``/``top_k`` are the
+defaults, ``top_p`` stays server-wide): temperature and top-k ride as
+[B] vectors in device state, vectorized inside the scanned step, so
+greedy and sampled requests share one program.  Each slot's PRNG
+stream splits exactly once per tick it is active, so sampled outputs
+are reproducible per seed and INVARIANT to scan batching — but do not
 replay the offline scan's key schedule.
+
+Cancelled / deadline-expired active slots are killed device-side (a
+tiny jitted ``remaining``-zeroing op) so they stop burning ticks
+instead of decoding out their budget as zombies.
 
 Not here yet (ROADMAP open items): paged / non-contiguous KV blocks
 (each slot owns a contiguous [L] stripe, so max_len bounds every
-request), speculative decode, and per-request sampling params.
+request), speculative decode, and a TP/mesh-sharded tick.
 """
 from __future__ import annotations
 
@@ -70,7 +89,7 @@ import numpy as np
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.analysis import sanitize as _sanitize
 from deeplearning4j_tpu.models.generation import (TransformerGenerator,
-                                                  _filter_logits)
+                                                  _filter_logits_rows)
 from deeplearning4j_tpu.parallel.inference import _bucket
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience.errors import (CancelledError,
@@ -91,7 +110,20 @@ _RETIRED = telemetry.counter(
     "generation_server_retired_total",
     "requests retired back to their caller (budget or EOS)")
 _TICKS = telemetry.counter(
-    "generation_server_ticks_total", "jitted decode ticks dispatched")
+    "generation_server_ticks_total",
+    "device decode ticks executed (a K-tick scan counts K)")
+_SCANS = telemetry.counter(
+    "generation_server_scan_ticks_total",
+    "fused decode scans dispatched, by scan length k (k=1 is the "
+    "admission-pending fallback)", labelnames=("k",))
+_HOST_SYNCS = telemetry.counter(
+    "generation_server_host_syncs_total",
+    "device->host polls by the scheduler (one per decode scan — the "
+    "dispatch-overhead denominator; syncs/token ~ 1/k steady-state)")
+_TOK_PER_DISPATCH = telemetry.gauge(
+    "generation_server_tokens_per_dispatch",
+    "new tokens emitted by the last decode dispatch (active slots x "
+    "live scan ticks — the host-sync amortization factor)")
 _SLOTS_BUSY = telemetry.gauge(
     "generation_server_slots_busy", "slots decoding at the last tick")
 _QDEPTH = telemetry.gauge(
@@ -133,21 +165,44 @@ _CANCELLED = telemetry.counter(
     "requests released via handle.cancel() before completion")
 
 
+def _pow2_floor(n: int) -> int:
+    """Largest power of two <= n (n >= 1) — scan lengths quantize to
+    powers of two so the compile count stays log2(tick_batch), and a
+    floor (never a ceil) means a drain scan never runs ticks past the
+    longest live budget."""
+    b = 1
+    while b * 2 <= n:
+        b *= 2
+    return b
+
+
+def _kill_slots(state, mask):
+    """Zero the remaining budget of masked slots — the device-side
+    early-kill for cancelled / deadline-expired requests, so a zombie
+    slot stops consuming scan ticks the moment the host notices
+    instead of decoding out its budget.  Jitted with ``state`` donated
+    (``GenerationServer._kill``)."""
+    return dict(state, remaining=jnp.where(mask, 0, state["remaining"]))
+
+
 class _Pending:
     """One submitted request.  ``result()`` blocks the caller; the
     scheduler thread fills ``_result``/``_error`` and sets the event.
     ``ttft`` (seconds) is populated when the first token lands."""
 
-    __slots__ = ("prompt", "n_new", "eos_id", "seed", "t_submit",
-                 "deadline", "cancelled", "t0", "emitted", "ttft",
-                 "_result", "_error", "_event")
+    __slots__ = ("prompt", "n_new", "eos_id", "seed", "temperature",
+                 "top_k", "t_submit", "deadline", "cancelled", "t0",
+                 "emitted", "ttft", "_result", "_error", "_event")
 
     def __init__(self, prompt, n_new, eos_id, seed,
+                 temperature: float = 0.0, top_k: int = 1,
                  deadline: Optional[float] = None):
         self.prompt = prompt
         self.n_new = n_new
         self.eos_id = eos_id
         self.seed = seed
+        self.temperature = temperature   # resolved: <= 0 means greedy
+        self.top_k = top_k               # resolved: vocab means "off"
         self.t_submit = time.perf_counter()
         self.deadline = deadline         # absolute time.monotonic(), or None
         self.cancelled = False
@@ -197,17 +252,26 @@ class GenerationServer:
     >>> out = h.result(); h.ttft                         # seconds
     >>> srv.shutdown(drain=True)                         # finish work
 
-    ``temperature``/``top_k``/``top_p`` configure sampling for ALL
-    requests (greedy by default — byte-identical to offline
-    ``generate()``); ``eos_id`` per request stops decode early the tick
-    the token is emitted.
+    ``temperature``/``top_k`` are per-request DEFAULTS (greedy by
+    default — byte-identical to offline ``generate()``), overridable
+    via ``submit(..., sampling={"temperature": .., "top_k": ..,
+    "seed": ..})``; ``top_p`` stays server-wide; ``eos_id`` per
+    request stops decode early the tick the token is emitted.
+
+    ``tick_batch`` fuses up to that many decode ticks into one
+    device-side ``lax.scan`` so the host syncs once per scan instead
+    of once per token (throughput knob; 1 restores per-tick host
+    polling).  The TTFT cost is bounded: the scheduler drops back to
+    single ticks whenever a request is waiting for admission, so a
+    join waits at most one in-flight scan.
 
     Resilience knobs: ``tick_timeout_s`` arms the watchdog (None
-    disables it); ``request_deadline_s`` is the default per-request
-    deadline (``submit*``'s ``deadline_s`` overrides); blocking
-    ``submit`` retries ``RetryableServerError`` failures up to
-    ``submit_retries`` times with jittered exponential backoff from
-    ``retry_backoff_s``."""
+    disables it; the stuck-tick deadline scales by the in-flight scan
+    length — a K-tick scan legitimately runs ~K x longer);
+    ``request_deadline_s`` is the default per-request deadline
+    (``submit*``'s ``deadline_s`` overrides); blocking ``submit``
+    retries ``RetryableServerError`` failures up to ``submit_retries``
+    times with jittered exponential backoff from ``retry_backoff_s``."""
 
     def __init__(self, net, n_slots: int = 8,
                  max_len: Optional[int] = None,
@@ -215,6 +279,7 @@ class GenerationServer:
                  temperature: float = 0.0,
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
+                 tick_batch: int = 8,
                  queue_limit: int = 1024,
                  tick_timeout_s: Optional[float] = 30.0,
                  request_deadline_s: Optional[float] = None,
@@ -240,6 +305,9 @@ class GenerationServer:
         self.temperature = float(temperature)
         self.top_k = top_k
         self.top_p = top_p
+        self.tick_batch = int(tick_batch)
+        if self.tick_batch < 1:
+            raise ValueError("tick_batch must be >= 1")
         self.tick_timeout_s = (float(tick_timeout_s)
                                if tick_timeout_s else None)
         self.request_deadline_s = (float(request_deadline_s)
@@ -258,7 +326,12 @@ class GenerationServer:
         self._ids = np.zeros((self.n_slots, self.max_len),
                              np.int32)                # host output rows
         self.refresh_params()
-        self._tick = self._build_tick()
+        # decode programs: keyed (scan length, any-sampled-slot) — the
+        # all-greedy variant skips the sort/categorical sampler math
+        # entirely, so a greedy-only server pays nothing for the
+        # vectorized per-slot sampling support
+        self._scan_cache = {}
+        self._kill = jax.jit(_kill_slots, donate_argnums=(0,))
         self._admit_cache = {}
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue(
             maxsize=queue_limit)
@@ -308,6 +381,10 @@ class GenerationServer:
             "eos": jnp.full((B,), -1, jnp.int32),     # -1 disables
             "logits": jnp.zeros((B, self._vocab), jnp.float32),
             "key": jnp.zeros((B, 2), jnp.uint32),     # per-slot PRNG
+            # per-slot sampling params (vectorized inside the scanned
+            # step): temp <= 0 decodes greedy, top_k == vocab is "off"
+            "temp": jnp.zeros((B,), jnp.float32),
+            "tk": jnp.full((B,), self._vocab, jnp.int32),
         }
         # commit atomically: this also runs on the watchdog's recovery
         # path while the (fenced) scheduler may still be snapshotting
@@ -341,17 +418,49 @@ class GenerationServer:
         with self._lock:
             return (not self._shutdown and self._worker.is_alive())
 
+    def _resolve_sampling(self, sampling, seed):
+        """Merge a per-request ``sampling`` dict over the server-wide
+        defaults -> (temperature, effective top_k, seed).  top_k is
+        resolved to the vocab size ("off") for greedy requests so the
+        device-side [B] vectors always hold valid values."""
+        samp = dict(sampling or {})
+        unknown = set(samp) - {"temperature", "top_k", "seed"}
+        if unknown:
+            raise ValueError(
+                f"unknown sampling key(s) {sorted(unknown)} (expected "
+                "temperature / top_k / seed)")
+        temp = float(samp.get("temperature", self.temperature))
+        tk = samp.get("top_k", None)
+        if tk is not None:
+            if temp <= 0:
+                raise ValueError("sampling top_k needs temperature > 0 "
+                                 "(greedy ignores the filtered tail)")
+            tk = int(tk)
+            if not 1 <= tk <= self._vocab:
+                raise ValueError(f"sampling top_k={tk} out of range "
+                                 f"[1, {self._vocab}] (vocab size)")
+        elif temp > 0 and self.top_k is not None:
+            tk = int(self.top_k)         # server-wide default
+        tk_eff = self._vocab if tk is None else tk
+        return temp, tk_eff, int(samp.get("seed", seed))
+
     def submit_async(self, prompt_ids, n_new: int,
                      eos_id: Optional[int] = None,
                      seed: int = 0,
-                     deadline_s: Optional[float] = None) -> _Pending:
+                     deadline_s: Optional[float] = None,
+                     sampling: Optional[dict] = None) -> _Pending:
         """Enqueue one sequence; returns a handle whose ``result()``
         blocks.  ``prompt_ids`` is a 1-D int array; the request decodes
         until ``n_new`` tokens are emitted or ``eos_id`` is sampled.
         ``deadline_s`` (default: the server's ``request_deadline_s``)
         bounds the request's total residence — queue wait included;
         past it the request fails with ``DeadlineExceededError`` and
-        its slot is reclaimed."""
+        its slot is reclaimed.  ``sampling`` overrides the server-wide
+        sampling defaults for THIS request: a dict with any of
+        ``temperature`` (<= 0 is greedy), ``top_k``, ``seed`` —
+        per-request values ride as [B] vectors in device state, so
+        greedy and sampled requests share slots in one program
+        (``top_p`` remains server-wide)."""
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("GenerationServer has been shut down")
@@ -370,8 +479,10 @@ class GenerationServer:
                       else float(deadline_s))
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
+        temp, tk_eff, seed = self._resolve_sampling(sampling, seed)
         req = _Pending(prompt, n_new,
-                       -1 if eos_id is None else int(eos_id), int(seed),
+                       -1 if eos_id is None else int(eos_id), seed,
+                       temperature=temp, top_k=tk_eff,
                        deadline=deadline)
         while True:
             try:
@@ -396,6 +507,7 @@ class GenerationServer:
                eos_id: Optional[int] = None, seed: int = 0,
                timeout: Optional[float] = None,
                deadline_s: Optional[float] = None,
+               sampling: Optional[dict] = None,
                retries: Optional[int] = None) -> np.ndarray:
         """Blocking ``submit_async().result()``.  ``retries`` (default:
         the server's ``submit_retries``) re-submits after a
@@ -407,7 +519,8 @@ class GenerationServer:
 
         def attempt():
             return self.submit_async(prompt_ids, n_new, eos_id, seed,
-                                     deadline_s=deadline_s).result(timeout)
+                                     deadline_s=deadline_s,
+                                     sampling=sampling).result(timeout)
 
         if retries <= 0:
             return attempt()
@@ -468,55 +581,101 @@ class GenerationServer:
         return False
 
     # -- compiled programs ---------------------------------------------
-    def _build_tick(self):
-        """ONE static-shape decode tick over all B slots: sample each
-        active slot's next token from its held logits, write it at the
-        slot's position, advance every cache one step, decrement
-        budgets, zero the budget on EOS.  Inactive slots flow through
-        with a masked write at their stale position — rows beyond a
-        slot's live prefix are never attended before being rewritten,
-        so the garbage is unreachable."""
+    def _sampler(self, sampled: bool):
+        """Token chooser for the scanned step: the all-greedy variant
+        is pure argmax (no sort / categorical / key-split work in the
+        program at all); the sampled variant vectorizes per-slot
+        temperature/top-k and splits every slot's PRNG stream exactly
+        once per tick — greedy rows select the argmax out of the same
+        program, so one scan serves mixed greedy+sampled slots."""
+        tp = self.top_p
+
+        def pick_greedy(state):
+            return jnp.argmax(state["logits"], axis=-1), state["key"]
+
+        def pick_sampled(state):
+            both = jax.vmap(jax.random.split)(state["key"])
+            keys, subs = both[:, 0], both[:, 1]
+            temp = state["temp"]
+            safe = jnp.where(temp > 0, temp, 1.0)[:, None]
+            lg = _filter_logits_rows(state["logits"] / safe,
+                                     state["tk"], tp)
+            cand = jax.vmap(jax.random.categorical)(subs, lg)
+            tok = jnp.where(temp > 0, cand,
+                            jnp.argmax(state["logits"], axis=-1))
+            return tok, keys
+
+        return pick_sampled if sampled else pick_greedy
+
+    def _decode_scan(self, K: int, sampled: bool):
+        """K static-shape decode ticks fused into ONE ``lax.scan``
+        (cached per (K, sampled)): each tick samples every active
+        slot's next token from its held logits, writes it at the
+        slot's position, advances every cache one step, decrements
+        budgets, zeroes the budget on EOS.  Inactive slots (free, or
+        retired MID-SCAN by EOS / budget drain) flow through with a
+        masked write at position 0, NOT their stale pos: a
+        just-finished max-length request parks pos == max_len, and an
+        out-of-bounds positional-table take fills NaN — which the
+        clamped cache write would smear into row L-1 and poison the
+        slot's next request.  Row 0 of a FREE slot is always rewritten
+        by admission prefill before any read.
+
+        Returns ``(kc, vc, state, tokens [B, K], emitted [B],
+        n_alive)`` — tokens stage device-side and the host polls ONCE
+        per scan instead of once per token; ``emitted`` counts each
+        slot's live ticks so the host can unpack exactly the tokens
+        that were really generated, and ``n_alive`` is the device-
+        truth occupancy at scan end (feeds the slots-busy gauge
+        without another reduction host-side)."""
+        key = (int(K), bool(sampled))
+        fn = self._scan_cache.get(key)
+        if fn is not None:
+            return fn
         gen = self._gen
-        temp, tk, tp = self.temperature, self.top_k, self.top_p
+        pick = self._sampler(sampled)
 
-        def tick(emb_p, blk_stack, head_p, kc, vc, state):
-            active = state["remaining"] > 0
-            logits = state["logits"]
-            if temp > 0.0:
-                both = jax.vmap(jax.random.split)(state["key"])
-                keys, subs = both[:, 0], both[:, 1]
-                lg = _filter_logits(logits / temp, tk, tp)
-                tok = jax.vmap(jax.random.categorical)(subs, lg)
-            else:
-                keys = state["key"]
-                tok = jnp.argmax(logits, axis=-1)
-            tok = jnp.where(active, tok, 0).astype(jnp.int32)
-            # inactive slots step at position 0, NOT their stale pos: a
-            # just-finished max-length request parks pos == max_len,
-            # and an out-of-bounds positional-table take fills NaN —
-            # which the clamped cache write would smear into row L-1
-            # and poison the slot's next request (0*NaN = NaN through
-            # the attention mask).  Row 0 of a FREE slot is always
-            # rewritten by admission prefill before any read.
-            pos = jnp.where(active, state["pos"], 0)
-            new_logits, kc, vc = gen._step(emb_p, blk_stack, head_p,
-                                           kc, vc, tok, pos)
-            hit_eos = active & (tok == state["eos"])
-            remaining = jnp.where(active, state["remaining"] - 1, 0)
-            remaining = jnp.where(hit_eos, 0, remaining)
-            state = {
-                "pos": jnp.where(active, state["pos"] + 1, state["pos"]),
-                "remaining": remaining,
-                "eos": state["eos"],
-                "logits": jnp.where(active[:, None], new_logits, logits),
-                "key": keys,
-            }
-            return kc, vc, state, tok
+        def scan_fn(emb_p, blk_stack, head_p, kc, vc, state):
+            def step(carry, _):
+                kc, vc, state, emitted = carry
+                active = state["remaining"] > 0
+                logits = state["logits"]
+                tok, keys = pick(state)
+                tok = jnp.where(active, tok, 0).astype(jnp.int32)
+                pos = jnp.where(active, state["pos"], 0)
+                new_logits, kc, vc = gen._step(emb_p, blk_stack,
+                                               head_p, kc, vc, tok, pos)
+                hit_eos = active & (tok == state["eos"])
+                remaining = jnp.where(active, state["remaining"] - 1, 0)
+                remaining = jnp.where(hit_eos, 0, remaining)
+                state = {
+                    "pos": jnp.where(active, state["pos"] + 1,
+                                     state["pos"]),
+                    "remaining": remaining,
+                    "eos": state["eos"],
+                    "logits": jnp.where(active[:, None], new_logits,
+                                        logits),
+                    "key": keys,
+                    "temp": state["temp"],
+                    "tk": state["tk"],
+                }
+                emitted = emitted + active.astype(jnp.int32)
+                return (kc, vc, state, emitted), tok
 
-        # donate caches + state: the tick updates them in place instead
+            emitted0 = jnp.zeros(state["remaining"].shape, jnp.int32)
+            (kc, vc, state, emitted), toks = jax.lax.scan(
+                step, (kc, vc, state, emitted0), None, length=K)
+            n_alive = jnp.sum((state["remaining"] > 0)
+                              .astype(jnp.int32))
+            return kc, vc, state, toks.T, emitted, n_alive
+
+        # donate caches + state: the scan updates them in place instead
         # of copying both full [n_layers, B, h, L, dh] buffers per
-        # token (ignored with a warning on backends without donation)
-        return jax.jit(tick, donate_argnums=(3, 4, 5))
+        # dispatch (ignored with a warning on backends without
+        # donation)
+        fn = self._scan_cache[key] = jax.jit(scan_fn,
+                                             donate_argnums=(3, 4, 5))
+        return fn
 
     def _admit_fn(self, tb: int):
         """Admission program for prefill bucket ``tb`` (cached per
@@ -527,7 +686,7 @@ class GenerationServer:
         gen = self._gen
 
         def admit(emb_p, blk_stack, head_p, kc, vc, state, prompt, t0,
-                  slot, n_new, eos_id, key):
+                  slot, n_new, eos_id, key, temp, tk):
             # the SAME prefill program offline decode runs (parity
             # depends on it); t0 picks the last REAL position's logits
             # out of the padded bucket
@@ -543,6 +702,8 @@ class GenerationServer:
                     state["logits"], logits, (slot, 0)),
                 "key": jax.lax.dynamic_update_slice(
                     state["key"], key[None], (slot, 0)),
+                "temp": state["temp"].at[slot].set(temp),
+                "tk": state["tk"].at[slot].set(tk),
             }
             return kc, vc, state
 
@@ -569,7 +730,8 @@ class GenerationServer:
             emb_p, blk_stack, head_p, kc, vc, state,
             jnp.asarray(padded), np.int32(req.t0), np.int32(slot),
             np.int32(req.n_new), np.int32(req.eos_id),
-            jax.random.PRNGKey(req.seed))
+            jax.random.PRNGKey(req.seed),
+            np.float32(req.temperature), np.int32(req.top_k))
         _sanitize.mark_donated("serve/admit", kc, vc, state)
         with self._lock:
             if self._epoch != my_epoch:
@@ -627,9 +789,13 @@ class GenerationServer:
             return self._epoch != my_epoch
 
     def _mark_tick(self, my_epoch: int, value) -> None:
-        """Set/clear the in-flight dispatch timestamp, but only while
-        this scheduler still owns the epoch — a superseded thread must
-        not clobber the live scheduler's stuck-tick timer."""
+        """Set/clear the in-flight dispatch record ``(epoch, started,
+        k)``, but only while this scheduler still owns the epoch — a
+        superseded thread must not clobber the live scheduler's
+        stuck-tick timer.  ``k`` is the in-flight scan length: the
+        watchdog scales its stuck-tick deadline by it, because a
+        K-tick scan legitimately runs ~K x longer than one tick
+        (admission dispatches mark k=1)."""
         with self._lock:
             if self._epoch == my_epoch:
                 self._tick_started = value
@@ -720,7 +886,8 @@ class GenerationServer:
                     n_active = len(self._active)
                 self._retire_reaped(reaped)
                 for req, slot in admits:
-                    self._mark_tick(my_epoch, (my_epoch, time.monotonic()))
+                    self._mark_tick(my_epoch,
+                                    (my_epoch, time.monotonic(), 1))
                     committed = self._admit(req, slot, my_epoch)
                     self._mark_tick(my_epoch, None)
                     if not committed:
@@ -730,12 +897,31 @@ class GenerationServer:
                 if not n_active:
                     continue
                 emb_p, blk_stack, head_p = self._params
+                # adaptive scan length: single ticks while ANY request
+                # is waiting for admission (a join never waits behind a
+                # long scan — TTFT does not regress), else the largest
+                # power-of-two <= the longest live budget, capped at
+                # tick_batch (pow2 quantization bounds compiles at
+                # log2(tick_batch) variants; the floor means trailing
+                # drain scans never run ticks past every slot's
+                # retirement)
+                with self._lock:
+                    if self._epoch != my_epoch:
+                        return
+                    live = list(self._active.values())
+                    k_drain = max(r.n_new - r.emitted for r in live)
+                    sampled = any(r.temperature > 0.0 for r in live)
+                queue_busy = n_pending > 0 or not self._queue.empty()
+                k = (1 if queue_busy
+                     else min(self.tick_batch, _pow2_floor(k_drain)))
                 with tracer.span("serve/tick", active=n_active,
-                                 queued=n_pending):
-                    self._mark_tick(my_epoch, (my_epoch, time.monotonic()))
+                                 queued=n_pending, k=k):
+                    self._mark_tick(my_epoch,
+                                    (my_epoch, time.monotonic(), k))
                     # chaos site: a hung dispatch — the host blocks in
-                    # here past tick_timeout_s and the watchdog takes
-                    # over; on wake the epoch check fences us out
+                    # here past the (k-scaled) deadline and the
+                    # watchdog takes over; on wake the epoch check
+                    # fences us out
                     _faults.maybe_stall("serve_tick_stall")
                     # snapshot the pool atomically under the epoch
                     # check — a concurrent recovery swaps all three
@@ -748,14 +934,24 @@ class GenerationServer:
                                                   self._state)
                     _sanitize.check_not_donated("serve/tick", kc_in,
                                                 vc_in, state_in)
-                    kc, vc, state, tok = self._tick(
-                        emb_p, blk_stack, head_p, kc_in, vc_in,
-                        state_in)
+                    kc, vc, state, toks, emitted, n_alive = \
+                        self._decode_scan(k, sampled)(
+                            emb_p, blk_stack, head_p, kc_in, vc_in,
+                            state_in)
                     _sanitize.mark_donated("serve/tick", kc_in, vc_in,
                                            state_in)
-                    tok_h = np.asarray(tok)
+                    # THE host sync: one poll per k-tick scan — tokens
+                    # staged [B, K] device-side, per-slot live-tick
+                    # counts, budgets left (all off one dispatch)
+                    toks_h = np.asarray(toks)
+                    emit_h = np.asarray(emitted)
                     rem_h = np.asarray(state["remaining"])
+                    alive_h = int(n_alive)
+                    _HOST_SYNCS.inc()
                     self._mark_tick(my_epoch, None)
+                # device-truth occupancy at scan end (the host view is
+                # reconciled below after retire/cancel bookkeeping)
+                _SLOTS_BUSY.set(alive_h)
                 if _sanitize.active("nan"):
                     # the decode-tick finite check (the PR 2 poisoned-
                     # slot bug class): only ACTIVE slots' held logits
@@ -767,7 +963,9 @@ class GenerationServer:
                     _sanitize.check_finite_rows(
                         "serve/tick logits", np.asarray(state["logits"]),
                         mask, detail="slot KV cache poisoned?")
-                _TICKS.inc()
+                _TICKS.inc(k)
+                _SCANS.labels(k=str(k)).inc()
+                _TOK_PER_DISPATCH.set(float(emit_h.sum()))
                 _OCC.observe(n_active / self.n_slots)
                 now_p = time.perf_counter()
                 now_m = time.monotonic()
@@ -776,13 +974,21 @@ class GenerationServer:
                     if self._epoch != my_epoch:
                         return
                     self._kc, self._vc, self._state = kc, vc, state
+                    kill = []
                     for slot in list(self._active):
                         req = self._active[slot]
-                        self._ids[slot, req.t0 + req.emitted] = tok_h[slot]
-                        req.emitted += 1
-                        if req.ttft is None:
-                            req.ttft = now_p - req.t_submit
-                            _TTFT.observe(req.ttft)
+                        # unpack exactly the tokens this slot really
+                        # generated: emit_h counts its live ticks in
+                        # the scan (EOS / budget drain retire mid-scan)
+                        e = int(emit_h[slot])
+                        if e:
+                            base = req.t0 + req.emitted
+                            self._ids[slot, base:base + e] = \
+                                toks_h[slot, :e]
+                            req.emitted += e
+                            if req.ttft is None:
+                                req.ttft = now_p - req.t_submit
+                                _TTFT.observe(req.ttft)
                         done = rem_h[slot] == 0
                         expired = (req.deadline is not None
                                    and now_m > req.deadline)
@@ -790,15 +996,17 @@ class GenerationServer:
                             del self._active[slot]
                             self._free.append(slot)
                             finished.append((req, slot, done))
+                            if not done:
+                                kill.append(slot)
                     n_active = len(self._active)
                     n_pending = len(self._pending)
                 for req, slot, done in finished:
                     if done:
                         self._retire(req, slot)
                     elif req.cancelled:
-                        # the slot is freed host-side; device-side the
-                        # zombie row decodes out its (bounded) budget
-                        # harmlessly until the next admission rearms it
+                        # slot freed host-side AND budget zeroed
+                        # device-side (the kill dispatch above) — no
+                        # zombie ticks
                         _CANCELLED.inc()
                         self._retire(req, slot, error=CancelledError(
                             "generation request cancelled"))
@@ -808,6 +1016,28 @@ class GenerationServer:
                                      error=DeadlineExceededError(
                                          "generation request deadline "
                                          "elapsed mid-decode"))
+                if kill:
+                    # device-side early-kill: zero the cancelled /
+                    # expired slots' budgets so they stop burning scan
+                    # ticks as zombies (the slot is already freed
+                    # host-side; its row goes inactive the very next
+                    # dispatch).  Dispatched AFTER the finished
+                    # requests retired: if this dispatch fails, their
+                    # callers already have results/errors and the
+                    # inline recovery below rebuilds a zeroed pool —
+                    # nobody is left hanging on an unset event.
+                    mask = np.zeros((self.n_slots,), bool)
+                    mask[kill] = True
+                    with self._lock:
+                        if self._epoch != my_epoch:
+                            return
+                        st = self._state
+                        _sanitize.check_not_donated("serve/kill", st)
+                        # ledger-mark BEFORE the donating dispatch (a
+                        # host-side weakref record, not a buffer read)
+                        # so no name outlives its donation
+                        _sanitize.mark_donated("serve/kill", st)
+                        self._state = self._kill(st, jnp.asarray(mask))
                 # post-tick refresh so an idle pool scrapes as 0 busy
                 # (the loop blocks on the queue next, with no tick to
                 # update the gauges)
@@ -847,11 +1077,16 @@ class GenerationServer:
                 worker = self._worker
                 started = self._tick_started
                 epoch = self._epoch
+            # the stuck-tick deadline scales by the in-flight scan
+            # length: a K-tick scan legitimately runs ~K x one tick,
+            # and a fixed deadline would trip a spurious recovery
+            # (full KV-pool rebuild) on every long scan
             stuck = (started is not None and started[0] == epoch and
-                     time.monotonic() - started[1] > self.tick_timeout_s)
+                     time.monotonic() - started[1] >
+                     self.tick_timeout_s * max(1, started[2]))
             if stuck:
                 self._recover(f"dispatch exceeded tick_timeout_s="
-                              f"{self.tick_timeout_s:g}")
+                              f"{self.tick_timeout_s:g} x k={started[2]}")
             elif not worker.is_alive():
                 self._recover("scheduler thread died")
 

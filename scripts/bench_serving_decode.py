@@ -1,16 +1,22 @@
 #!/usr/bin/env python
 """Continuous-batching serve benchmark on the real chip ->
-SERVING_DECODE_r06.json: the ``GenerationServer`` concurrency ladder
-(aggregate new_tokens_per_sec + TTFT p50/p99 at 1/4/16 streams) vs the
-back-to-back single-caller ``generate()`` floor.
+SERVING_DECODE_r06.json: the ``GenerationServer`` tick-batch x
+concurrency grid — aggregate new_tokens_per_sec, TTFT p50/p99, and
+host syncs per token at 1/4/16 streams for each fused-scan length
+K in {1,4,8,16} — vs the back-to-back single-caller ``generate()``
+floor.
 
-The decode roofline says this should be nearly free: every tick
+Two separate wins stack here.  Continuous batching (PR 2): every tick
 streams the full bf16 parameter set whether 1 or 16 slots ride along
 (GENERATION_r05.json measured the fixed-batch rate at 31.4% of the
-params-bandwidth ideal), so continuous batching converts idle slot
-capacity straight into aggregate tokens/s.  The ISSUE 2 acceptance bar
-is >= 2x at 16 streams with greedy outputs byte-identical to offline
-decode (asserted by tests/test_generation_server.py).
+params-bandwidth ideal), so multiplexing converts idle slot capacity
+straight into aggregate tokens/s.  Multi-tick scan fusion (ISSUE 5):
+K decode ticks run as ONE device-side ``lax.scan`` and the host polls
+once per scan, so per-token dispatch overhead and the device->host
+sync drop ~1/K.  Acceptance bar: K=8 at 16 streams strictly above
+K=1 at 16 streams, steady-state host syncs per token <= 1/K, greedy
+outputs byte-identical to offline decode (asserted by
+tests/test_generation_server.py's parity matrix).
 """
 import json
 import os

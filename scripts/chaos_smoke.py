@@ -162,8 +162,12 @@ def main() -> int:
     # the worker thread dies mid-service, the watchdog fails in-flight
     # callers retryably and restarts admission; (2) a hung tick — the
     # stall exceeds tick_timeout_s, the watchdog fences the stuck
-    # scheduler out; each time the blocking submit retries through
+    # scheduler out; each time the blocking submit retries through.
+    # tick_batch=1 pins the single-tick watchdog deadline this matrix
+    # injects against (a fused K-tick scan legitimately stretches the
+    # deadline by K and would absorb the stall as a slow scan).
     with GenerationServer(gpt, n_slots=2, max_len=32, tick_timeout_s=0.8,
+                          tick_batch=1,
                           submit_retries=4, retry_backoff_s=0.02) as srv:
         srv.submit(p, n_new=2, timeout=300)          # warm the compiles
         with FaultInjector(["serve_tick_fail@0"]):

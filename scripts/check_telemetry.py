@@ -168,6 +168,7 @@ def main() -> int:
     from deeplearning4j_tpu.zoo.gpt import Gpt
 
     retired = registry.counter("generation_server_retired_total")
+    syncs = registry.counter("generation_server_host_syncs_total")
     retired_before = retired.value
     gpt = Gpt(vocab_size=50, max_len=32, d_model=32, n_layers=2,
               n_heads=4, d_ff=64, seq_len=8, compute_dtype=None,
@@ -183,9 +184,22 @@ def main() -> int:
                         f"generation request {i}: shape {out.shape}")
             except Exception as e:  # pragma: no cover - smoke surface
                 problems.append(f"generation request {i}: {e}")
-    if retired.value - retired_before != 3:
+        # one solo request with an empty queue: the scheduler must
+        # fuse its 4 ticks into ONE lax.scan dispatch (k=4) and poll
+        # the host once for it
+        syncs_before = syncs.value
+        try:
+            gs.submit(np.asarray([4, 3, 2, 1], np.int32), n_new=4,
+                      timeout=300)
+        except Exception as e:  # pragma: no cover - smoke surface
+            problems.append(f"solo scan request: {e}")
+        if syncs.value - syncs_before != 1:
+            problems.append(
+                f"solo 4-token request cost {syncs.value - syncs_before}"
+                " host syncs (expected 1 fused k=4 scan)")
+    if retired.value - retired_before != 4:
         problems.append(f"generation_server_retired_total grew "
-                        f"{retired.value - retired_before} != 3")
+                        f"{retired.value - retired_before} != 4")
 
     # -- static analysis: lint series on the wire ----------------------
     emit_analysis_series(problems)
@@ -216,6 +230,11 @@ def main() -> int:
         "generation_server_slots_busy",
         "generation_server_slot_occupancy_bucket",
         "generation_server_ticks_total",
+        # multi-tick decode scan series: the solo request above
+        # guarantees a k=4 fused scan ran and was host-polled once
+        "generation_server_host_syncs_total",
+        'generation_server_scan_ticks_total{k="4"}',
+        "generation_server_tokens_per_dispatch",
     ] + RESILIENCE_SERIES + ANALYSIS_SERIES
     problems += missing_series(body, required)
     if lat.count - lat_before != 16:

@@ -11,6 +11,7 @@ import pytest
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.models.generation import TransformerGenerator
 from deeplearning4j_tpu.parallel import GenerationServer
+from deeplearning4j_tpu.resilience import CancelledError, FaultInjector
 from deeplearning4j_tpu.zoo.gpt import Gpt
 
 
@@ -149,6 +150,163 @@ def test_validation(net):
             srv.submit(np.zeros(4, np.int32), n_new=0)
         with pytest.raises(ValueError, match="1-D"):
             srv.submit(np.zeros((2, 4), np.int32), n_new=2)
+
+
+@pytest.mark.parametrize("tb", [1, 4, 8])
+def test_multi_tick_parity_matrix(net, offline, tb):
+    """Byte-parity at every scan batching: staggered admission with
+    mixed budgets, an EOS early-retire (mid-scan for tb > 1), and a
+    cancel through a 2-slot pool — greedy outputs must equal offline
+    ``generate()`` exactly at K=1 (the per-tick fallback) and fused
+    scans alike."""
+    rng = np.random.default_rng(tb)
+    reqs = [(rng.integers(0, 50, t0).astype(np.int32), n_new)
+            for t0, n_new in [(3, 12), (5, 7), (4, 10)]]
+    eos_prompt = np.asarray([5, 9, 2, 7], np.int32)
+    ref_eos = offline.generate(eos_prompt[None], n_new=10)[0]
+    eos = int(ref_eos[4 + 3])                        # retires tick 4
+    first = 4 + int(np.argmax(ref_eos[4:] == eos))
+    with GenerationServer(net, n_slots=2, max_len=32, tick_batch=tb,
+                          tick_timeout_s=None) as srv:
+        handles = []
+        for prompt, n_new in reqs:
+            handles.append(srv.submit_async(prompt, n_new))
+            time.sleep(0.01)                         # stagger joins
+        h_eos = srv.submit_async(eos_prompt, n_new=10, eos_id=eos)
+        h_cancel = srv.submit_async(np.asarray([1, 2, 3], np.int32),
+                                    n_new=20)
+        assert h_cancel.cancel() is True
+        outs = [h.result(timeout=300) for h in handles]
+        out_eos = h_eos.result(timeout=300)
+        with pytest.raises(CancelledError):
+            h_cancel.result(timeout=300)
+    for (prompt, n_new), out in zip(reqs, outs):
+        np.testing.assert_array_equal(
+            out, offline.generate(prompt[None], n_new=n_new)[0])
+    np.testing.assert_array_equal(out_eos, ref_eos[:first + 1])
+
+
+def test_cancel_mid_decode_kills_device_slot(net, offline):
+    """Cancelling an ACTIVE request releases its slot at the next scan
+    boundary AND zeroes its device-side budget (the jitted kill op) —
+    the zombie row must stop burning ticks instead of decoding out its
+    budget, and the concurrent request still decodes exactly."""
+    p_long = np.asarray([1, 2, 3], np.int32)
+    p_other = np.asarray([7, 8, 9, 4], np.int32)
+    with GenerationServer(net, n_slots=2, max_len=32, tick_batch=4,
+                          tick_timeout_s=None) as srv:
+        # deterministically throttle the scheduler (~0.25s per loop
+        # pass for its first 15 passes): warm scans on this tiny model
+        # drain all 28 tokens in a few ms, so an unthrottled run can
+        # retire h_long BETWEEN two cancel polls and there would be
+        # nothing left to cancel
+        with FaultInjector([f"serve_tick_stall@{i}:0.25"
+                            for i in range(15)]):
+            h_long = srv.submit_async(p_long, n_new=28)
+            h_other = srv.submit_async(p_other, n_new=12)
+            deadline = time.monotonic() + 60
+            while h_long.emitted == 0 and time.monotonic() < deadline:
+                time.sleep(0.005)            # admitted and decoding
+            assert h_long.cancel() is True
+            with pytest.raises(CancelledError):
+                h_long.result(timeout=300)
+        np.testing.assert_array_equal(
+            h_other.result(timeout=300),
+            offline.generate(p_other[None], n_new=12)[0])
+        # with both retired the pool idles — the cancelled slot's
+        # device budget must be 0 (killed), not parked > 0 (zombie)
+        deadline = time.monotonic() + 30
+        rem = None
+        while time.monotonic() < deadline:
+            with srv._lock:
+                rem = np.asarray(srv._state["remaining"])
+            if int(rem.max()) == 0:
+                break
+            time.sleep(0.01)
+        assert int(rem.max()) == 0, rem
+
+
+def test_per_request_sampling_rides_with_greedy(net, offline):
+    """Per-request sampling params as [B] device vectors: a sampled
+    request shares the pool with a greedy one (greedy stays
+    byte-identical to offline), and — because each slot's PRNG splits
+    exactly once per tick it is active — the sampled output is
+    reproducible per seed and INVARIANT to the scan batching."""
+    pg = np.asarray([4, 5, 6], np.int32)
+    ps = np.asarray([1, 2, 3], np.int32)
+    outs = {}
+    for tb in (1, 8):
+        with GenerationServer(net, n_slots=2, max_len=32, tick_batch=tb,
+                              tick_timeout_s=None) as srv:
+            hg = srv.submit_async(pg, n_new=8)
+            hs = srv.submit_async(ps, n_new=8, sampling={
+                "temperature": 1.0, "top_k": 5, "seed": 11})
+            np.testing.assert_array_equal(
+                hg.result(timeout=300),
+                offline.generate(pg[None], n_new=8)[0])
+            outs[tb] = hs.result(timeout=300)
+    for out in outs.values():
+        assert out.shape == (11,)
+        assert (out >= 0).all() and (out < 50).all()
+        np.testing.assert_array_equal(out[:3], ps)
+    np.testing.assert_array_equal(outs[1], outs[8])
+
+
+def test_host_syncs_amortized_by_scan(net):
+    """A solo K=8 request in steady state polls the host once per
+    scan: 16 new tokens cost exactly 2 device->host syncs (<= 1/K per
+    token — the dispatch-overhead win the scan exists for)."""
+    reg = telemetry.get_registry()
+    syncs = reg.counter("generation_server_host_syncs_total")
+    ticks = reg.counter("generation_server_ticks_total")
+    p = np.asarray([1, 2, 3], np.int32)
+    with GenerationServer(net, n_slots=1, max_len=32, tick_batch=8,
+                          tick_timeout_s=None) as srv:
+        s0, t0 = syncs.value, ticks.value
+        out = srv.submit(p, n_new=16, timeout=300)
+    assert out.shape == (19,)
+    assert syncs.value - s0 == 2                 # two 8-tick scans
+    assert ticks.value - t0 == 16
+
+
+def test_sampling_and_tick_batch_validation(net):
+    with pytest.raises(ValueError, match="tick_batch"):
+        GenerationServer(net, n_slots=1, max_len=32, tick_batch=0)
+    with GenerationServer(net, n_slots=1, max_len=32) as srv:
+        p = np.asarray([1, 2, 3], np.int32)
+        with pytest.raises(ValueError, match="unknown sampling"):
+            srv.submit(p, n_new=2, sampling={"nope": 1})
+        with pytest.raises(ValueError, match="temperature"):
+            srv.submit(p, n_new=2, sampling={"top_k": 5})
+        with pytest.raises(ValueError, match="top_k"):
+            srv.submit(p, n_new=2,
+                       sampling={"temperature": 1.0, "top_k": 0})
+        with pytest.raises(ValueError, match="top_k"):
+            srv.submit(p, n_new=2,
+                       sampling={"temperature": 1.0, "top_k": 99})
+
+
+@pytest.mark.slow
+def test_multi_tick_soak_large_k(net, offline):
+    """16 staggered mixed-budget requests (some EOS) through 4 slots
+    at tick_batch=16 — the large-K steady state the bench ladder runs,
+    all byte-identical to offline decode."""
+    rng = np.random.default_rng(5)
+    with GenerationServer(net, n_slots=4, max_len=32, tick_batch=16,
+                          tick_timeout_s=None) as srv:
+        reqs, handles = [], []
+        for i in range(16):
+            t0 = int(rng.integers(3, 8))
+            n_new = int(rng.integers(4, 24 - t0))
+            p = rng.integers(0, 50, t0).astype(np.int32)
+            reqs.append((p, n_new))
+            handles.append(srv.submit_async(p, n_new=n_new))
+            if i % 3 == 0:
+                time.sleep(0.01)
+        for (p, n_new), h in zip(reqs, handles):
+            np.testing.assert_array_equal(
+                h.result(timeout=300),
+                offline.generate(p[None], n_new=n_new)[0])
 
 
 def test_generate_rejects_out_of_range_top_k(net):

@@ -395,16 +395,47 @@ def test_watchdog_recovers_scheduler_crash_concurrent_callers(net,
     assert srv._healthy.value == 0
 
 
+def test_watchdog_scan_deadline_scales_with_k(net, offline):
+    """A K-tick scan legitimately runs ~K x one tick: a stall LONGER
+    than tick_timeout_s but inside the K-scaled deadline must NOT trip
+    a spurious recovery (full KV-pool rebuild) — the request rides
+    through the slow scan untouched.  Regression for the multi-tick
+    watchdog fix: pre-fix the fixed deadline fired on every long
+    scan."""
+    from deeplearning4j_tpu.parallel import GenerationServer
+    restarts = REG.counter("serve_watchdog_restarts_total")
+    p = np.asarray([1, 2, 3], np.int32)
+    with GenerationServer(net, n_slots=1, max_len=32,
+                          tick_timeout_s=60, tick_batch=8) as srv:
+        srv.submit(p, n_new=8, timeout=300)   # warm: compiles the K=8 scan
+        # tighten the deadline only now — first-dispatch COMPILES are
+        # allowed to be slow; the fix under test is the steady-state
+        # deadline, read per watchdog check
+        srv.tick_timeout_s = 0.4
+        w0 = restarts.value
+        # 1.2s > tick_timeout_s would trip a single-tick deadline, but
+        # the in-flight dispatch is marked k=8 -> deadline 3.2s
+        with FaultInjector(["serve_tick_stall@0:1.2"]):
+            out = srv.submit(p, n_new=8, timeout=300)
+        assert restarts.value - w0 == 0
+        assert srv.healthy()
+    np.testing.assert_array_equal(
+        out, offline.generate(p[None], n_new=8)[0])
+
+
 @pytest.mark.slow  # tier-1 covers this path via test_chaos_smoke
 def test_watchdog_recovers_stuck_tick_with_submit_retry(net, offline):
     """A hung tick (stall past tick_timeout_s): the watchdog fences the
     stuck scheduler out, and a blocking submit with retries enabled
-    rides through the recovery transparently."""
+    rides through the recovery transparently.  tick_batch=1 keeps the
+    single-tick deadline this test targets (a fused scan would
+    legitimately stretch it by K)."""
     from deeplearning4j_tpu.parallel import GenerationServer
     restarts = REG.counter("serve_watchdog_restarts_total")
     w0 = restarts.value
     p = np.asarray([5, 6, 7], np.int32)
     with GenerationServer(net, n_slots=2, max_len=32, tick_timeout_s=1.0,
+                          tick_batch=1,
                           submit_retries=4, retry_backoff_s=0.02) as srv:
         srv.submit(p, n_new=2, timeout=300)          # warm the compiles
         with FaultInjector(["serve_tick_stall@0:4.0"]):
