@@ -496,7 +496,7 @@ def _cond_stub(*args, **attrs):
 
 @register_op("fused_attention")
 def _fused_attention(q, k, v, bias=None, causal=False, scale=None,
-                     compute_dtype=None):
+                     compute_dtype=None, bias_layout=None):
     """softmax(QK^T*scale + bias)V in one node — the lowering target of
     the importer's attention-subgraph rewrite (``autodiff/rewrites.py``).
     Routes to the Pallas flash kernel when shape/mask permit, else to
@@ -512,8 +512,14 @@ def _fused_attention(q, k, v, bias=None, causal=False, scale=None,
     squeeze_head = q.ndim == 3
     if squeeze_head:   # [b, t, d] -> single-head [b, 1, t, d]
         q, k, v = q[:, None], k[:, None], v[:, None]
-    out = attention(q, k, v,
-                    bias=None if bias is None else jnp.asarray(bias),
+    if bias is not None:
+        bias = jnp.asarray(bias)
+        if bias_layout == "qk" and bias.ndim == 2:
+            # declared square [tq, tk] attention bias (the kept causal
+            # mask): lift to [1, 1, tq, tk] — the kernel's bare-2-D
+            # convention is a [b, tk] padding mask, ambiguous with this
+            bias = bias[None, None]
+    out = attention(q, k, v, bias=bias,
                     causal=bool(causal),
                     scale=None if scale is None else float(scale))
     if squeeze_head:

@@ -550,14 +550,22 @@ def _run_rewrite_pass(sd: SameDiff, tag: str, fn,
 
 
 def optimize_for_tpu(sd: SameDiff,
-                     compute_dtype: Optional[str] = None) -> Dict[str, int]:
+                     compute_dtype: Optional[str] = None,
+                     fold_causal_masks: bool = True) -> Dict[str, int]:
     """Run the full imported-graph canonicalization pipeline — the
     platform-helper seam in one call.  Returns per-pass fusion counts.
 
     With ``DL4J_TPU_REWRITE_CHECK=1`` every pass asserts eval_shape
     parity on the graph's outputs (see :func:`rewrite_check_enabled`);
     the attention pass skips the dtype half of the check when
-    ``compute_dtype`` deliberately re-types the fused node."""
+    ``compute_dtype`` deliberately re-types the fused node.
+
+    ``fold_causal_masks=False`` keeps constant-triangular attention
+    biases as explicit ``[t, t]`` bias operands instead of folding them
+    into the kernel's ``causal=True`` path — the opt-out for callers
+    FINE-TUNING an importer-promoted trainable mask (the fold freezes
+    it at exact-causal and it stops receiving gradients); the default
+    folds, which is what every frozen-import serving path wants."""
     carry: Dict[str, object] = {}
     return {
         "parallel_matmuls": _run_rewrite_pass(
@@ -569,7 +577,8 @@ def optimize_for_tpu(sd: SameDiff,
                                   carry=carry),
         "attention": _run_rewrite_pass(
             sd, "attention",
-            lambda: fuse_attention(sd, compute_dtype=compute_dtype),
+            lambda: fuse_attention(sd, compute_dtype=compute_dtype,
+                                   fold_causal_masks=fold_causal_masks),
             check_dtypes=compute_dtype is None, carry=carry),
         # last: operates on the matmuls the passes above left unfused
         "flatten_reshapes": _run_rewrite_pass(
@@ -814,8 +823,8 @@ def _bias_is_causal_mask(sd: SameDiff, maps: _Maps, bias_name: str
                 and np.all(a[~tril] <= -1e8))
 
 
-def fuse_attention(sd: SameDiff, compute_dtype: Optional[str] = None
-                   ) -> int:
+def fuse_attention(sd: SameDiff, compute_dtype: Optional[str] = None,
+                   fold_causal_masks: bool = True) -> int:
     """Rewrite attention subgraphs into ``fused_attention`` nodes.
 
     Every intermediate must have exactly one consumer (so the rewrite
@@ -824,8 +833,12 @@ def fuse_attention(sd: SameDiff, compute_dtype: Optional[str] = None
 
     ``compute_dtype='bfloat16'`` makes the fused node run its matmuls
     at full MXU rate (the training configuration); None preserves
-    import numerics exactly (parity tests).  Returns the number of
-    attention sites fused."""
+    import numerics exactly (parity tests).
+    ``fold_causal_masks=False`` keeps a constant-triangular bias as an
+    explicit operand (the ``[t, t]``-memory path) so an importer-
+    promoted trainable mask keeps receiving gradients — see
+    :func:`optimize_for_tpu`.  Returns the number of attention sites
+    fused."""
     total = 0
     while True:                      # re-derive maps after each fusion
         maps = _Maps(sd)
@@ -856,20 +869,30 @@ def fuse_attention(sd: SameDiff, compute_dtype: Optional[str] = None
             return total
         si, mi, passthrough, q, k, v, bias, scale, chain = match
         causal = False
+        bias_layout = None
         if bias is not None and _bias_is_causal_mask(sd, maps, bias):
-            # constant-valued triangular mask == causal=True: drop the
-            # mask operand so the flash kernel's causal path is
-            # reachable (a [t, t] query-dependent bias never is)
-            bv = sd.vars.get(bias)
-            if bv is not None and bv.var_type == "VARIABLE":
-                # the importer promoted the mask const to a trainable
-                # VARIABLE; folding freezes it at exact-causal — say so
-                # (same honesty stance as the dropout-drop warning)
-                log.warning(
-                    "fuse_attention: causal-fusing mask variable %s — "
-                    "it is replaced by the kernel's causal path and no "
-                    "longer receives gradient updates", bias)
-            causal, bias = True, None
+            if fold_causal_masks:
+                # constant-valued triangular mask == causal=True: drop
+                # the mask operand so the flash kernel's causal path is
+                # reachable (a [t, t] query-dependent bias never is)
+                bv = sd.vars.get(bias)
+                if bv is not None and bv.var_type == "VARIABLE":
+                    # the importer promoted the mask const to a
+                    # trainable VARIABLE; folding freezes it at
+                    # exact-causal — say so (same honesty stance as
+                    # the dropout-drop warning)
+                    log.warning(
+                        "fuse_attention: causal-fusing mask variable "
+                        "%s — it is replaced by the kernel's causal "
+                        "path and no longer receives gradient updates",
+                        bias)
+                causal, bias = True, None
+            else:
+                # opt-out (fine-tuning the mask): keep the operand,
+                # but a square [tq, tk] bias must be declared — the
+                # lowering's 2-D convention is a [b, tk] key-position
+                # padding mask, and b == tq makes the two ambiguous
+                bias_layout = "qk"
         # Fusion-path honesty (VERDICT r3 weak 1): a dropout node in
         # the probs chain is deleted by this rewrite.  The registry's
         # `dropout` op is ALREADY inert (imported graphs freeze
@@ -888,11 +911,13 @@ def fuse_attention(sd: SameDiff, compute_dtype: Optional[str] = None
                     "with it)", n.outputs[0], rate)
         drop = set(chain) | set(passthrough) | {si, mi}
         inputs = [q, k, v] + ([bias] if bias is not None else [])
+        attrs = {"causal": causal,
+                 "scale": 1.0 if scale is None else float(scale),
+                 "compute_dtype": compute_dtype}
+        if bias_layout is not None:
+            attrs["bias_layout"] = bias_layout
         fused = OpNode("fused_attention", inputs,
-                       [sd.ops[mi].outputs[0]],
-                       {"causal": causal,
-                        "scale": 1.0 if scale is None else float(scale),
-                        "compute_dtype": compute_dtype})
+                       [sd.ops[mi].outputs[0]], attrs)
         new_ops: List[OpNode] = []
         for i, n in enumerate(sd.ops):
             if i == mi:

@@ -169,22 +169,24 @@ def _filter_logits_rows(logits, top_k, top_p):
     """Per-row variant of ``_filter_logits`` for the generation
     server's vectorized sampler: ``top_k`` is a [b] int32 VECTOR (one
     k per slot; k == vocab disables filtering for that row — the
-    minimum logit becomes the threshold and nothing is below it), so
-    requests with different top-k settings ride one traced program.
-    ``top_p`` stays a static scalar (server-wide knob)."""
+    minimum logit becomes the threshold and nothing is below it) and
+    ``top_p`` is a [b] float32 VECTOR (one nucleus mass per slot;
+    p >= 1 disables the cut for that row), so requests with different
+    top-k/top-p settings ride one traced program."""
     V = logits.shape[-1]
     srt = jnp.sort(logits, axis=-1)              # ascending
     kth = jnp.take_along_axis(srt, (V - top_k)[:, None], axis=-1)
     logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p is not None:
-        srt_d = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(srt_d, axis=-1)
-        csum = jnp.cumsum(probs, axis=-1)
-        cut = (csum - probs) >= float(top_p)
-        srt_d = jnp.where(cut, jnp.inf, srt_d)
-        thresh = jnp.min(srt_d, axis=-1, keepdims=True)
-        logits = jnp.where(logits < thresh, -jnp.inf, logits)
-    return logits
+    srt_d = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(srt_d, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    # drop tokens whose preceding cumulative mass already covers p
+    # (the top token always survives); the p >= 1 guard keeps "off"
+    # rows EXACTLY unfiltered even when float cumsum rounds past 1
+    cut = ((csum - probs) >= top_p[:, None]) & (top_p[:, None] < 1.0)
+    srt_d = jnp.where(cut, jnp.inf, srt_d)
+    thresh = jnp.min(srt_d, axis=-1, keepdims=True)
+    return jnp.where(logits < thresh, -jnp.inf, logits)
 
 
 def _filter_logits(logits, top_k, top_p):

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.analysis import sanitize as _sanitize
 from deeplearning4j_tpu.nlp.tokenizer import DefaultTokenizerFactory
 
 
@@ -252,6 +253,13 @@ class Word2Vec:
                 lr = max(self.min_learning_rate,
                          self.learning_rate * (1 - t / n_batches_total))
                 key, sub = jax.random.split(key)
+                # donation discipline (DL4J_TPU_SANITIZE=donation): the
+                # step donates syn0/syn1 in place — ledger-check, mark
+                # BEFORE the dispatch (a host-side weakref record, not
+                # a read — JIT105), then rebind to the outputs (shared
+                # by Word2Vec NS/HS and the FastText subword step)
+                _sanitize.check_not_donated("nlp/sgd_step", syn0, syn1)
+                _sanitize.mark_donated("nlp/sgd_step", syn0, syn1)
                 syn0, syn1, loss = step(
                     syn0, syn1, jnp.asarray(batch[:, 0]),
                     jnp.asarray(batch[:, 1]), jnp.asarray(lr, jnp.float32),

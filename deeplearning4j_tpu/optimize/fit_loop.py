@@ -209,9 +209,13 @@ def run_fit(model, iterator, n_epochs: int,
                 # save mid-batch (tBPTT chunk) would store an
                 # iteration/RNG position the batch-granular
                 # batch_in_epoch cannot express, and resume would
-                # replay chunks under shifted step indices
+                # replay chunks under shifted step indices.  The poll
+                # is fleet-coordinated when a FleetCoordinator is
+                # installed: the flag or-reduces over the global mesh
+                # so EVERY rank answers identically here and the forced
+                # saves all carry the same step label.
                 if ci == len(chunks) - 1 and \
-                        _preemption.preemption_requested():
+                        _preemption.poll_preemption():
                     _preemption.PREEMPTIONS.inc()
                     final = _preemption_save(_checkpoint_listener(model),
                                              model)

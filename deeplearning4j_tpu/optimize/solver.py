@@ -18,6 +18,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.analysis import sanitize as _sanitize
 from deeplearning4j_tpu.optimize.updaters import BaseUpdater
 
 
@@ -218,6 +219,17 @@ class Solver:
         model_state, loss).  Donated inputs must not be reused by caller.
         ``lr_scale`` multiplies the final update (BadStepPolicy backoff);
         passed traced, so changing it does not recompile."""
+        # use-after-donate ledger (DL4J_TPU_SANITIZE=donation): the
+        # step donates all three trees — a caller that re-reads an old
+        # tree instead of the returned one trips here, not as silent
+        # garbage.  Off: one frozenset lookup.  Ledger-marked BEFORE
+        # the dispatch (a host-side weakref record, not a buffer read
+        # — JIT105): a failed dispatch may have consumed the donated
+        # buffers anyway, so the conservative marking stands.
+        _sanitize.check_not_donated("solver/step", params, opt_state,
+                                    model_state)
+        _sanitize.mark_donated("solver/step", params, opt_state,
+                               model_state)
         out = self._step(params, opt_state, model_state,
                          jnp.asarray(step_idx, jnp.int32), batch, rng,
                          float(lr_scale))

@@ -27,6 +27,27 @@ from deeplearning4j_tpu.resilience import faults as _faults
 
 log = logging.getLogger("deeplearning4j_tpu")
 
+
+def _globalize(tree):
+    """Orbax's multiprocess contract: every ``jax.Array`` it serializes
+    must be a GLOBAL array (each process holding only its addressable
+    shards).  Fully-addressable leaves — counters, the PRNG stream key,
+    any single-device scalar — are process-local values, replicated by
+    construction in the synchronous loop, so they serialize as numpy
+    (orbax writes those from the primary host) and restore bit-exactly
+    on every rank.  Single-process: identity."""
+    import jax
+    if jax.process_count() == 1:
+        return tree
+    import numpy as np
+
+    def conv(v):
+        if isinstance(v, jax.Array) and v.is_fully_addressable:
+            return np.asarray(v)
+        return v
+    return jax.tree_util.tree_map(conv, tree)
+
+
 _SAVES = telemetry.counter(
     "checkpoint_saves_total", "sharded checkpoint saves initiated")
 _FAILURES = telemetry.counter(
@@ -58,7 +79,8 @@ class ShardedCheckpointer:
         # chaos site: simulated shard-write failure for THIS step label
         _faults.maybe_fail("checkpoint_fail", int(step))
         _SAVES.inc()
-        self._mgr.save(int(step), args=ocp.args.StandardSave(state),
+        self._mgr.save(int(step),
+                       args=ocp.args.StandardSave(_globalize(state)),
                        metrics=metrics, force=force)
 
     def restore_latest(self, like: Any):
@@ -68,11 +90,19 @@ class ShardedCheckpointer:
         step = self._mgr.latest_step()
         if step is None:
             return None, None
-        state = self._mgr.restore(step, args=ocp.args.StandardRestore(like))
+        state = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(_globalize(like)))
         return step, state
 
     def all_steps(self):
         return list(self._mgr.all_steps())
+
+    def delete_step(self, step: int):
+        """Drop one checkpoint step — the fleet-agreement primitive:
+        a rank holding a step its peers lack (e.g. a forced final save
+        that landed on some hosts only) discards it so every rank's
+        ``restore_latest`` resolves to the agreed common step."""
+        self._mgr.delete(int(step))
 
     def wait(self):
         """Block until pending async saves land (call before exit)."""
@@ -87,8 +117,10 @@ class CheckpointListener(TrainingListener):
     DL4J ``CheckpointListener`` surface on the sharded checkpointer."""
 
     def __init__(self, directory, save_every_n_iterations: Optional[int] = None,
-                 save_every_n_epochs: Optional[int] = None, keep_last: int = 3):
-        self.ckpt = ShardedCheckpointer(directory, keep_last=keep_last)
+                 save_every_n_epochs: Optional[int] = None, keep_last: int = 3,
+                 async_save: bool = True):
+        self.ckpt = ShardedCheckpointer(directory, keep_last=keep_last,
+                                        async_save=async_save)
         self.every_iter = save_every_n_iterations
         self.every_epoch = save_every_n_epochs
         # Last orbax step label saved by THIS listener: when an epoch
@@ -107,6 +139,12 @@ class CheckpointListener(TrainingListener):
         hook = getattr(model, "_param_sync_hook", None)
         if hook is not None:   # lazily-synced trainer-owned params
             hook()
+            sync_opt = getattr(hook, "sync_opt", None)
+            if sync_opt is not None:
+                # pipeline trainer: the live optimizer state is the
+                # pipe-structured trainer-side tree — capture it so the
+                # checkpoint can resume the pipeline path exactly
+                sync_opt()
         state = {"params": model.params_tree,
                  "opt_state": model.opt_state,
                  "model_state": model.state_tree,

@@ -46,6 +46,21 @@ def initialize(coordinator_address: Optional[str] = None,
                       num_processes=num_processes, process_id=process_id)
         if local_device_ids is not None:
             kwargs["local_device_ids"] = list(local_device_ids)
+    platforms = jax.config.jax_platforms  # None = auto-detect
+    if kwargs and (platforms is None or "cpu" in str(platforms)):
+        # The stock XLA CPU client has no cross-process collectives
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend"): a cluster joined with explicit args (loopback
+        # chaos tests, the fleet workers, CPU dev rigs) must ask for
+        # the gloo-backed client BEFORE the backend initializes.  The
+        # option only selects the CPU client's collectives — TPU/GPU
+        # collectives are untouched, and the bare-TPU-pod discovery
+        # path (no kwargs) never takes this branch.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # option absent or backend already live:
+            pass           # initialize() proceeds; collectives may 501
     try:
         # Fail LOUDLY when cluster args were given: a multi-host job that
         # silently degrades to single-process training trains on 1/N of
@@ -103,3 +118,76 @@ def process_count() -> int:
 
 def process_index() -> int:
     return jax.process_index()
+
+
+# -- tiny in-band control-plane collectives ---------------------------------
+#
+# Fleet coordination (resilience/coordination.py) rides the SAME data
+# plane as gradients: a [n_devices] int32 array — one element per
+# device, every process contributing its local value replicated across
+# its addressable devices — reduced by a jitted min/max.  The result is
+# fully replicated, so every process reads the identical answer off its
+# own shard without any second transport (no sockets, no files: the
+# Spark-driver analogue of a control RPC collapses into one ICI/DCN
+# all-reduce piggybacked between training steps).  COLLECTIVE: every
+# process in the job must call with the same mesh at the same point.
+
+def _control_mesh(mesh: Optional[Mesh] = None) -> Mesh:
+    """A 1-axis mesh over the job's devices for control collectives —
+    the caller's training mesh reshaped flat, or all devices."""
+    devs = (np.asarray(mesh.devices).reshape(-1) if mesh is not None
+            else np.asarray(jax.devices()))
+    return Mesh(devs, ("fleet",))
+
+
+# (reduce_fn, device ids) -> (jitted reducer, input sharding, local
+# device count).  The preemption poll runs once per training step:
+# rebuilding the mesh and re-jitting there would put a retrace on
+# every step boundary.
+_CONTROL_CACHE: dict = {}
+
+
+def _reduce_scalar(reduce_fn, value: int,
+                   mesh: Optional[Mesh] = None) -> int:
+    key = (reduce_fn, None if mesh is None
+           else tuple(d.id for d in mesh.devices.flat))
+    cached = _CONTROL_CACHE.get(key)
+    if cached is None:
+        cmesh = _control_mesh(mesh)
+        cached = (jax.jit(reduce_fn,
+                          out_shardings=NamedSharding(cmesh, P())),
+                  NamedSharding(cmesh, P("fleet")),
+                  sum(d.process_index == jax.process_index()
+                      for d in cmesh.devices.flat))
+        _CONTROL_CACHE[key] = cached
+    jitted, sharding, mine = cached
+    local = np.full((mine,), int(value), np.int32)
+    if jax.process_count() == 1:
+        arr = jax.device_put(local, sharding)
+    else:
+        arr = jax.make_array_from_process_local_data(sharding, local)
+    return int(jitted(arr))
+
+
+def or_reduce_flag(flag: bool, mesh: Optional[Mesh] = None) -> bool:
+    """Fleet-wide OR of a per-process flag (max-reduce of 0/1) — the
+    in-band preemption broadcast: any process's SIGTERM is visible to
+    every process at the same step boundary."""
+    import jax.numpy as jnp
+    return bool(_reduce_scalar(jnp.max, 1 if flag else 0, mesh))
+
+
+def min_reduce(value: int, mesh: Optional[Mesh] = None) -> int:
+    """Fleet-wide minimum of a per-process integer — the
+    newest-common-checkpoint agreement primitive (each process offers
+    its newest step; the minimum is a step every process has)."""
+    import jax.numpy as jnp
+    return int(_reduce_scalar(jnp.min, value, mesh))
+
+
+def sum_reduce(value: int, mesh: Optional[Mesh] = None) -> int:
+    """Fleet-wide sum — the rendezvous barrier primitive: summing one
+    1 per device blocks until every process dispatches, and the total
+    proves the whole fleet arrived."""
+    import jax.numpy as jnp
+    return int(_reduce_scalar(jnp.sum, value, mesh))

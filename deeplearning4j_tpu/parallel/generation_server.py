@@ -41,11 +41,18 @@ Self-healing (resilience layer): the scheduler's in-flight state
 (active slots, wait line, free list) lives on the INSTANCE under a
 lock, and the scheduler thread holds an epoch token — so a watchdog
 thread can declare a tick stuck (``tick_timeout_s`` exceeded) or the
-scheduler dead, fail the in-flight requests with a typed
-``RetryableServerError``, rebuild the slot pool, bump the epoch (the
-old thread, if it ever wakes, sees the stale token and exits without
-touching anything), and start a fresh scheduler — admission resumes
-instead of the server dying with its callers blocked forever.
+scheduler dead, bump the epoch (the old thread, if it ever wakes, sees
+the stale token and exits without touching anything), and start a
+fresh scheduler — admission resumes instead of the server dying with
+its callers blocked forever.  Recovery is SURGICAL (KV salvage): the
+rows + per-slot device state of slots NOT implicated in the failure
+are snapshotted under the epoch-checked lock and scattered back into
+the rebuilt pool, so unaffected in-flight requests complete without
+resubmission, byte-identical to offline ``generate()`` — only the
+implicated slot(s) (a raising admission's slot, non-finite state, or
+an unrecoverable donated pool) fail with a typed
+``RetryableServerError``; queued requests just wait the recovery out
+(``kv_slots_salvaged_total`` / ``kv_slots_dropped_total``).
 Requests carry optional deadlines (queue wait counts), handles can be
 ``cancel()``-ed to release their queue entry/slot budget, blocking
 ``submit()`` optionally retries retryable failures with jittered
@@ -57,10 +64,10 @@ Greedy decode through the server is byte-identical to offline
 ``TransformerGenerator.generate()`` per request — the tick runs the
 same stacked-params layer scan, at every scan length.  Sampling is
 PER REQUEST (``submit(..., sampling={"temperature": .., "top_k": ..,
-"seed": ..})``; the constructor's ``temperature``/``top_k`` are the
-defaults, ``top_p`` stays server-wide): temperature and top-k ride as
-[B] vectors in device state, vectorized inside the scanned step, so
-greedy and sampled requests share one program.  Each slot's PRNG
+"top_p": .., "seed": ..})``; the constructor's ``temperature``/
+``top_k``/``top_p`` are the defaults): temperature, top-k and top-p
+ride as [B] vectors in device state, vectorized inside the scanned
+step, so greedy and sampled requests share one program.  Each slot's PRNG
 stream splits exactly once per tick it is active, so sampled outputs
 are reproducible per seed and INVARIANT to scan batching — but do not
 replay the offline scan's key schedule.
@@ -163,6 +170,16 @@ _DEADLINE_EXCEEDED = telemetry.counter(
 _CANCELLED = telemetry.counter(
     "generation_server_cancelled_total",
     "requests released via handle.cancel() before completion")
+# Surgical-recovery series: a recovery that salvages N-1 of N slots is
+# routine self-healing; growth in dropped slots is lost caller work.
+_KV_SALVAGED = telemetry.counter(
+    "kv_slots_salvaged_total",
+    "in-flight slots whose KV rows + device state survived a pool "
+    "recovery (the requests completed without resubmission)")
+_KV_DROPPED = telemetry.counter(
+    "kv_slots_dropped_total",
+    "in-flight slots failed by a pool recovery (implicated in the "
+    "failure, non-finite state, or unrecoverable donated buffers)")
 
 
 def _pow2_floor(n: int) -> int:
@@ -191,11 +208,12 @@ class _Pending:
     ``ttft`` (seconds) is populated when the first token lands."""
 
     __slots__ = ("prompt", "n_new", "eos_id", "seed", "temperature",
-                 "top_k", "t_submit", "deadline", "cancelled", "t0",
-                 "emitted", "ttft", "_result", "_error", "_event")
+                 "top_k", "top_p", "t_submit", "deadline", "cancelled",
+                 "t0", "emitted", "ttft", "_result", "_error", "_event")
 
     def __init__(self, prompt, n_new, eos_id, seed,
                  temperature: float = 0.0, top_k: int = 1,
+                 top_p: float = 1.0,
                  deadline: Optional[float] = None):
         self.prompt = prompt
         self.n_new = n_new
@@ -203,6 +221,7 @@ class _Pending:
         self.seed = seed
         self.temperature = temperature   # resolved: <= 0 means greedy
         self.top_k = top_k               # resolved: vocab means "off"
+        self.top_p = top_p               # resolved: 1.0 means "off"
         self.t_submit = time.perf_counter()
         self.deadline = deadline         # absolute time.monotonic(), or None
         self.cancelled = False
@@ -252,11 +271,11 @@ class GenerationServer:
     >>> out = h.result(); h.ttft                         # seconds
     >>> srv.shutdown(drain=True)                         # finish work
 
-    ``temperature``/``top_k`` are per-request DEFAULTS (greedy by
-    default — byte-identical to offline ``generate()``), overridable
-    via ``submit(..., sampling={"temperature": .., "top_k": ..,
-    "seed": ..})``; ``top_p`` stays server-wide; ``eos_id`` per
-    request stops decode early the tick the token is emitted.
+    ``temperature``/``top_k``/``top_p`` are per-request DEFAULTS
+    (greedy by default — byte-identical to offline ``generate()``),
+    overridable via ``submit(..., sampling={"temperature": ..,
+    "top_k": .., "top_p": .., "seed": ..})``; ``eos_id`` per request
+    stops decode early the tick the token is emitted.
 
     ``tick_batch`` fuses up to that many decode ticks into one
     device-side ``lax.scan`` so the host syncs once per scan instead
@@ -302,6 +321,8 @@ class GenerationServer:
         if top_k is not None and not 1 <= int(top_k) <= self._vocab:
             raise ValueError(f"top_k={top_k} out of range "
                              f"[1, {self._vocab}] (vocab size)")
+        if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+            raise ValueError(f"top_p={top_p} out of range (0, 1]")
         self.temperature = float(temperature)
         self.top_k = top_k
         self.top_p = top_p
@@ -336,6 +357,11 @@ class GenerationServer:
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue(
             maxsize=queue_limit)
         self._active = {}                # slot -> request
+        self._staged = set()             # in _active, prefill not yet
+                                         # COMMITTED (device rows are a
+                                         # previous occupant's) — a
+                                         # recovery must fail these,
+                                         # never salvage them
         self._pending = []               # admitted-order wait line
         self._free = list(range(self.n_slots - 1, -1, -1))
         self._epoch = 0
@@ -382,9 +408,11 @@ class GenerationServer:
             "logits": jnp.zeros((B, self._vocab), jnp.float32),
             "key": jnp.zeros((B, 2), jnp.uint32),     # per-slot PRNG
             # per-slot sampling params (vectorized inside the scanned
-            # step): temp <= 0 decodes greedy, top_k == vocab is "off"
+            # step): temp <= 0 decodes greedy, top_k == vocab and
+            # top_p == 1.0 are "off"
             "temp": jnp.zeros((B,), jnp.float32),
             "tk": jnp.full((B,), self._vocab, jnp.int32),
+            "tp": jnp.ones((B,), jnp.float32),
         }
         # commit atomically: this also runs on the watchdog's recovery
         # path while the (fenced) scheduler may still be snapshotting
@@ -420,15 +448,16 @@ class GenerationServer:
 
     def _resolve_sampling(self, sampling, seed):
         """Merge a per-request ``sampling`` dict over the server-wide
-        defaults -> (temperature, effective top_k, seed).  top_k is
-        resolved to the vocab size ("off") for greedy requests so the
-        device-side [B] vectors always hold valid values."""
+        defaults -> (temperature, effective top_k, effective top_p,
+        seed).  top_k resolves to the vocab size and top_p to 1.0
+        ("off") for greedy requests so the device-side [B] vectors
+        always hold valid values."""
         samp = dict(sampling or {})
-        unknown = set(samp) - {"temperature", "top_k", "seed"}
+        unknown = set(samp) - {"temperature", "top_k", "top_p", "seed"}
         if unknown:
             raise ValueError(
                 f"unknown sampling key(s) {sorted(unknown)} (expected "
-                "temperature / top_k / seed)")
+                "temperature / top_k / top_p / seed)")
         temp = float(samp.get("temperature", self.temperature))
         tk = samp.get("top_k", None)
         if tk is not None:
@@ -441,8 +470,20 @@ class GenerationServer:
                                  f"[1, {self._vocab}] (vocab size)")
         elif temp > 0 and self.top_k is not None:
             tk = int(self.top_k)         # server-wide default
+        tp = samp.get("top_p", None)
+        if tp is not None:
+            if temp <= 0:
+                raise ValueError("sampling top_p needs temperature > 0 "
+                                 "(greedy ignores the filtered tail)")
+            tp = float(tp)
+            if not 0.0 < tp <= 1.0:
+                raise ValueError(f"sampling top_p={tp} out of range "
+                                 "(0, 1]")
+        elif temp > 0 and self.top_p is not None:
+            tp = float(self.top_p)       # server-wide default
         tk_eff = self._vocab if tk is None else tk
-        return temp, tk_eff, int(samp.get("seed", seed))
+        tp_eff = 1.0 if tp is None else tp
+        return temp, tk_eff, tp_eff, int(samp.get("seed", seed))
 
     def submit_async(self, prompt_ids, n_new: int,
                      eos_id: Optional[int] = None,
@@ -457,10 +498,10 @@ class GenerationServer:
         past it the request fails with ``DeadlineExceededError`` and
         its slot is reclaimed.  ``sampling`` overrides the server-wide
         sampling defaults for THIS request: a dict with any of
-        ``temperature`` (<= 0 is greedy), ``top_k``, ``seed`` —
-        per-request values ride as [B] vectors in device state, so
-        greedy and sampled requests share slots in one program
-        (``top_p`` remains server-wide)."""
+        ``temperature`` (<= 0 is greedy), ``top_k``, ``top_p``,
+        ``seed`` — per-request values ride as [B] vectors in device
+        state, so greedy and sampled requests share slots in one
+        program."""
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("GenerationServer has been shut down")
@@ -479,10 +520,11 @@ class GenerationServer:
                       else float(deadline_s))
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
-        temp, tk_eff, seed = self._resolve_sampling(sampling, seed)
+        temp, tk_eff, tp_eff, seed = self._resolve_sampling(sampling,
+                                                            seed)
         req = _Pending(prompt, n_new,
                        -1 if eos_id is None else int(eos_id), seed,
-                       temperature=temp, top_k=tk_eff,
+                       temperature=temp, top_k=tk_eff, top_p=tp_eff,
                        deadline=deadline)
         while True:
             try:
@@ -585,10 +627,10 @@ class GenerationServer:
         """Token chooser for the scanned step: the all-greedy variant
         is pure argmax (no sort / categorical / key-split work in the
         program at all); the sampled variant vectorizes per-slot
-        temperature/top-k and splits every slot's PRNG stream exactly
-        once per tick — greedy rows select the argmax out of the same
-        program, so one scan serves mixed greedy+sampled slots."""
-        tp = self.top_p
+        temperature/top-k/top-p and splits every slot's PRNG stream
+        exactly once per tick — greedy rows select the argmax out of
+        the same program, so one scan serves mixed greedy+sampled
+        slots."""
 
         def pick_greedy(state):
             return jnp.argmax(state["logits"], axis=-1), state["key"]
@@ -599,7 +641,7 @@ class GenerationServer:
             temp = state["temp"]
             safe = jnp.where(temp > 0, temp, 1.0)[:, None]
             lg = _filter_logits_rows(state["logits"] / safe,
-                                     state["tk"], tp)
+                                     state["tk"], state["tp"])
             cand = jax.vmap(jax.random.categorical)(subs, lg)
             tok = jnp.where(temp > 0, cand,
                             jnp.argmax(state["logits"], axis=-1))
@@ -658,6 +700,7 @@ class GenerationServer:
                     "key": keys,
                     "temp": state["temp"],
                     "tk": state["tk"],
+                    "tp": state["tp"],
                 }
                 emitted = emitted + active.astype(jnp.int32)
                 return (kc, vc, state, emitted), tok
@@ -686,7 +729,7 @@ class GenerationServer:
         gen = self._gen
 
         def admit(emb_p, blk_stack, head_p, kc, vc, state, prompt, t0,
-                  slot, n_new, eos_id, key, temp, tk):
+                  slot, n_new, eos_id, key, temp, tk, tp):
             # the SAME prefill program offline decode runs (parity
             # depends on it); t0 picks the last REAL position's logits
             # out of the padded bucket
@@ -704,6 +747,7 @@ class GenerationServer:
                     state["key"], key[None], (slot, 0)),
                 "temp": state["temp"].at[slot].set(temp),
                 "tk": state["tk"].at[slot].set(tk),
+                "tp": state["tp"].at[slot].set(tp),
             }
             return kc, vc, state
 
@@ -731,12 +775,15 @@ class GenerationServer:
             jnp.asarray(padded), np.int32(req.t0), np.int32(slot),
             np.int32(req.n_new), np.int32(req.eos_id),
             jax.random.PRNGKey(req.seed),
-            np.float32(req.temperature), np.int32(req.top_k))
+            np.float32(req.temperature), np.int32(req.top_k),
+            np.float32(req.top_p))
         _sanitize.mark_donated("serve/admit", kc, vc, state)
         with self._lock:
             if self._epoch != my_epoch:
                 return False
             self._kc, self._vc, self._state = out
+            self._staged.discard(slot)   # prefill committed: device
+                                         # rows are THIS request's now
             # _ids row under the same lock: _retire copies from it
             self._ids[slot, :req.t0] = req.prompt
         _ADMITTED.inc()
@@ -802,16 +849,179 @@ class GenerationServer:
 
     def _fail_all_in_flight(self, err) -> None:
         """Clear active + pending under the lock and fail every caller;
-        the slot pool/free list resets to empty."""
+        the slot pool/free list resets to empty.  The SHUTDOWN teardown
+        — recovery paths use :meth:`_recover_pool`, which salvages."""
         with self._lock:
             victims = list(self._active.values()) + list(self._pending)
             self._active.clear()
+            self._staged.clear()
             self._pending = []
             self._free = list(range(self.n_slots - 1, -1, -1))
         for req in victims:
             self._retire(req, -1, error=err)
         _SLOTS_BUSY.set(0)
         _QDEPTH.set(self._queue.qsize())
+
+    def _recover_pool(self, my_epoch: int, err,
+                      implicated=frozenset()) -> bool:
+        """Surgical pool recovery: salvage the KV rows + per-slot
+        device state of active slots NOT implicated in the failure,
+        rebuild the pool, scatter the salvaged rows back in, and fail
+        ONLY the implicated slots — unaffected in-flight requests keep
+        their slot, their emitted prefix and their PRNG stream, and
+        complete without resubmission (byte-identical to offline
+        ``generate()``: the salvaged rows are the exact KV bytes the
+        uninterrupted decode would have read).
+
+        A slot is implicated when (a) the caller names it (the
+        admission dispatch that raised), (b) its held state is
+        non-finite (the poisoned-slot class — decoding on from NaN
+        logits would emit garbage), or (c) its request was cancelled /
+        deadline-expired (being torn down anyway).  When any pool leaf
+        was consumed by a donating dispatch that never returned (a real
+        hung XLA program — ``is_deleted`` on TPU) nothing is
+        recoverable and every active slot drops: the pre-salvage
+        behavior, now the worst case instead of the only case.
+
+        Queued-but-unadmitted requests are never touched: they hold no
+        pool state and simply wait out the recovery.  Runs under the
+        epoch-checked lock (PR 4 discipline); returns False when a
+        concurrent recovery superseded ``my_epoch``."""
+        to_fail = []
+        with self._lock:
+            if self._epoch != my_epoch:
+                return False
+            kc, vc, state = self._kc, self._vc, self._state
+            try:
+                pool_alive = not any(
+                    getattr(leaf, "is_deleted", lambda: False)()
+                    for leaf in jax.tree_util.tree_leaves(
+                        (kc, vc, state)))
+                if pool_alive:
+                    # trust-but-verify the salvage source: a slot whose
+                    # KV rows or held logits are non-finite (the PR 2
+                    # poisoned-slot class) must NOT be carried over —
+                    # it would keep emitting garbage forever.  One
+                    # device-side reduce + a [B] transfer, not a full
+                    # pool pull.
+                    finite = np.asarray(
+                        jnp.isfinite(state["logits"]).all(axis=1)
+                        & jnp.isfinite(kc).all(axis=(0, 2, 3, 4))
+                        & jnp.isfinite(vc).all(axis=(0, 2, 3, 4)))
+                    pos_h = np.asarray(state["pos"])
+                    rem_h = np.asarray(state["remaining"])
+            except RuntimeError:
+                # a still-running donating dispatch consumed a buffer
+                # between the is_deleted probe and the read (backends
+                # honor donation eagerly): nothing is salvageable
+                pool_alive = False
+            now = time.monotonic()
+            victims = {}                     # slot -> why
+            if not pool_alive:
+                for slot in self._active:
+                    victims[slot] = "unrecoverable"
+            else:
+                for slot, req in self._active.items():
+                    if slot in implicated:
+                        victims[slot] = "implicated"
+                    elif slot in self._staged:
+                        # staged into _active but its prefill never
+                        # COMMITTED: its device rows are a previous
+                        # occupant's leftovers — salvaging would
+                        # retire it as "done" with garbage bytes.
+                        # Fail retryably: no work was applied.
+                        victims[slot] = "unadmitted"
+                    elif req.cancelled:
+                        victims[slot] = "cancelled"
+                    elif req.deadline is not None and now > req.deadline:
+                        victims[slot] = "deadline"
+                    elif not bool(finite[slot]):
+                        victims[slot] = "poisoned"
+                    elif pos_h[slot] == 0 and rem_h[slot] == 0:
+                        # device-truth backstop for the same class on
+                        # a never-used slot (prefill sets pos >= 1)
+                        victims[slot] = "unadmitted"
+            keep = sorted(s for s in self._active if s not in victims)
+            if pool_alive and keep:
+                # snapshot-salvage the kept rows and scatter them into
+                # a rebuilt (zeroed) pool in one masked pass: the old
+                # arrays are read eagerly (no donation), so this IS the
+                # gather + fresh pool + scatter-back, fused — kept
+                # slots carry their exact KV bytes, positions, budgets
+                # and PRNG streams; every other row is the fresh-pool
+                # zero state
+                mask = np.zeros((self.n_slots,), bool)
+                mask[keep] = True
+                m = jnp.asarray(mask)
+                row = lambda nd: m.reshape((1, -1) + (1,) * (nd - 2))
+                try:
+                    # ledger-checked read (DL4J_TPU_SANITIZE=donation):
+                    # the salvage source must not be a buffer some
+                    # dispatch already owns — the dynamic mirror of the
+                    # is_deleted guard above.  SanitizerError is a
+                    # RuntimeError: a tripped ledger (a stuck tick DID
+                    # mark the pool before hanging) demotes to the
+                    # drop-all rebuild below instead of killing the
+                    # watchdog thread.
+                    _sanitize.check_not_donated("serve/salvage", kc,
+                                                vc, state)
+                    self._kc = jnp.where(row(kc.ndim), kc, 0)
+                    self._vc = jnp.where(row(vc.ndim), vc, 0)
+                    self._state = {
+                        "pos": jnp.where(m, state["pos"], 0),
+                        "remaining": jnp.where(m, state["remaining"],
+                                               0),
+                        "eos": jnp.where(m, state["eos"], -1),
+                        "logits": jnp.where(m[:, None],
+                                            state["logits"], 0),
+                        "key": jnp.where(m[:, None], state["key"], 0),
+                        "temp": jnp.where(m, state["temp"], 0.0),
+                        "tk": jnp.where(m, state["tk"], self._vocab),
+                        "tp": jnp.where(m, state["tp"], 1.0),
+                    }
+                except RuntimeError:
+                    # consumed mid-rebuild: demote every kept slot to
+                    # unrecoverable and fall back to the clean rebuild
+                    for slot in keep:
+                        victims[slot] = "unrecoverable"
+                    keep = []
+                    self._fresh_pool()
+            else:
+                # nothing salvageable (or nothing active): clean
+                # rebuild — the donating dispatch may have consumed
+                # the old buffers.  RLock: _fresh_pool's own commit
+                # nests inside this epoch-checked section.
+                self._fresh_pool()
+            for slot, why in victims.items():
+                to_fail.append((self._active.pop(slot), why))
+            self._staged.clear()         # every staged slot just fell
+                                         # into victims["unadmitted"]
+            self._free = [s for s in range(self.n_slots - 1, -1, -1)
+                          if s not in self._active]
+            n_active = len(self._active)
+            n_pending = len(self._pending)
+        if keep:
+            _KV_SALVAGED.inc(len(keep))
+        if to_fail:
+            _KV_DROPPED.inc(len(to_fail))
+        log.warning("pool recovery: salvaged %d in-flight slot(s) %s, "
+                    "dropped %d (%s)", len(keep), keep, len(to_fail),
+                    ", ".join(why for _, why in to_fail) or "none")
+        for req, why in to_fail:
+            if why == "cancelled":
+                _CANCELLED.inc()
+                self._retire(req, -1, error=CancelledError(
+                    "generation request cancelled"))
+            elif why == "deadline":
+                _DEADLINE_EXCEEDED.inc()
+                self._retire(req, -1, error=DeadlineExceededError(
+                    "generation request deadline elapsed before "
+                    "completion"))
+            else:
+                self._retire(req, -1, error=err)
+        _SLOTS_BUSY.set(n_active)
+        _QDEPTH.set(n_pending + self._queue.qsize())
+        return True
 
     def _run(self, my_epoch: int):
         tracer = telemetry.get_tracer()
@@ -868,6 +1078,7 @@ class GenerationServer:
                     _QDEPTH.set(0)
                     return
             try:
+                admitting = None    # slot mid-prefill, for implication
                 now = time.monotonic()
                 with self._lock:
                     if self._epoch != my_epoch:
@@ -879,8 +1090,12 @@ class GenerationServer:
                         slot = self._free.pop()
                         # active BEFORE the prefill dispatch: if the
                         # watchdog takes over mid-admission the request
-                        # must be in the set it fails over
+                        # must be in the set it fails over — staged
+                        # until the prefill COMMITS, so the recovery
+                        # fails it instead of salvaging the previous
+                        # occupant's device rows as its result
                         self._active[slot] = req
+                        self._staged.add(slot)
                         admits.append((req, slot))
                     n_pending = len(self._pending)
                     n_active = len(self._active)
@@ -888,7 +1103,9 @@ class GenerationServer:
                 for req, slot in admits:
                     self._mark_tick(my_epoch,
                                     (my_epoch, time.monotonic(), 1))
+                    admitting = slot     # a raising prefill implicates
                     committed = self._admit(req, slot, my_epoch)
+                    admitting = None     # only ITS slot in recovery
                     self._mark_tick(my_epoch, None)
                     if not committed:
                         return
@@ -1043,7 +1260,7 @@ class GenerationServer:
                 # update the gauges)
                 _SLOTS_BUSY.set(n_active)
                 _QDEPTH.set(n_pending + self._queue.qsize())
-            except Exception as e:  # surface to every blocked caller
+            except Exception as e:  # surface to the implicated callers
                 self._mark_tick(my_epoch, None)
                 with self._lock:
                     if self._epoch != my_epoch:
@@ -1055,11 +1272,16 @@ class GenerationServer:
                     "retry")
                 err.__cause__ = e
                 log.exception("GenerationServer tick/admit failed; "
-                              "rebuilding the slot pool")
-                self._fail_all_in_flight(err)
-                # the failed dispatch may have consumed the donated
-                # buffers mid-update: rebuild a clean inactive pool
-                self._fresh_pool()
+                              "salvaging unaffected slots")
+                # surgical rebuild: a raising ADMISSION implicates only
+                # the admitting slot (its prefill never committed);
+                # everything else salvages unless the failed dispatch
+                # consumed the donated pool buffers mid-update
+                implicated = (frozenset((admitting,))
+                              if admitting is not None else frozenset())
+                if not self._recover_pool(my_epoch, err,
+                                          implicated=implicated):
+                    return       # a watchdog recovery superseded us
 
     # -- watchdog ------------------------------------------------------
     def _watch(self):
@@ -1099,12 +1321,15 @@ class GenerationServer:
             self._tick_started = None
             self._healthy.set(0)
         _WATCHDOG_RESTARTS.inc()
-        log.warning("GenerationServer watchdog: %s — failing in-flight "
-                    "requests and restarting the scheduler", reason)
-        self._fail_all_in_flight(RetryableServerError(
+        log.warning("GenerationServer watchdog: %s — salvaging "
+                    "unaffected slots and restarting the scheduler",
+                    reason)
+        # surgical: unimplicated in-flight slots keep their KV rows and
+        # device state and complete under the NEW scheduler without
+        # resubmission; only unrecoverable slots fail retryably
+        self._recover_pool(new_epoch, RetryableServerError(
             f"decode scheduler recovered ({reason}); the request "
             f"failed in flight and was not applied — safe to retry"))
-        self._fresh_pool()
         with self._lock:
             if self._stop_event.is_set() or self._shutdown:
                 return

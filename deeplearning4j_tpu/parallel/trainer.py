@@ -206,9 +206,6 @@ class ShardedTrainer:
                         "(configured rate %.3g)", drop)
         self._pipe = (lo, hi)
         _PIPE_BUBBLE.set((S - 1) / (S - 1 + self.n_micro))
-        blocks = [model.params_tree[f"layer_{i}"] for i in range(lo, hi)]
-        stacked = jax.tree_util.tree_map(
-            lambda *ls: jnp.stack(ls), *blocks)
 
         tp, mesh = self.tp, self.mesh
         tp_rules = {"Wqkv": "col", "W1": "col", "W2": "row", "Wo": "row"}
@@ -240,24 +237,35 @@ class ShardedTrainer:
         # copies, not views: the jitted step DONATES its params, and
         # donated aliases of the model's own tree would delete them
         cp = lambda t: jax.tree_util.tree_map(jnp.array, t)
-        pre = {f"layer_{i}": cp(model.params_tree[f"layer_{i}"])
-               for i in range(lo)}
-        post = {f"layer_{i}": cp(model.params_tree[f"layer_{i}"])
-                for i in range(hi, len(model.layers))}
-        params = {"pre": pre, "blocks": stacked, "post": post}
 
         def place(tree, spec_fn):
             return jax.device_put(tree, jax.tree_util.tree_map_with_path(
                 lambda p, a: NamedSharding(mesh, spec_fn(p, a)), tree))
 
-        params["blocks"] = place(params["blocks"], stacked_spec)
-        for part in ("pre", "post"):
-            for name in params[part]:
-                params[part][name] = place(params[part][name],
-                                           outer_spec(name))
-        self._pipe_params = params
+        def stack_and_place():
+            """model.params_tree (per-layer) -> placed pipe params
+            {pre, blocks (stacked [S] leading axis), post} — used at
+            init AND as the inverse of ``sync_model`` when a restored
+            checkpoint overwrites the model tree (resume/rollback)."""
+            blocks = [model.params_tree[f"layer_{i}"]
+                      for i in range(lo, hi)]
+            stacked = jax.tree_util.tree_map(
+                lambda *ls: jnp.stack(ls), *blocks)
+            pre = {f"layer_{i}": cp(model.params_tree[f"layer_{i}"])
+                   for i in range(lo)}
+            post = {f"layer_{i}": cp(model.params_tree[f"layer_{i}"])
+                    for i in range(hi, len(model.layers))}
+            params = {"pre": pre, "blocks": place(stacked, stacked_spec),
+                      "post": post}
+            for part in ("pre", "post"):
+                for name in params[part]:
+                    params[part][name] = place(params[part][name],
+                                               outer_spec(name))
+            return params
+
+        self._stack_and_place = stack_and_place
         self._updater = model._updater
-        self._pipe_opt = self._updater.init_state(params)
+        self._restack()
 
         layers, confs = model.layers, model.conf
         block_conf = layers[lo]
@@ -328,7 +336,6 @@ class ShardedTrainer:
         # holds the trainer WEAKLY: a model outliving its trainer must
         # not pin the stacked pipe params + optimizer state in memory.
         import weakref
-        self._model_stale = False
         wr = weakref.ref(self)
 
         def _hook():
@@ -339,12 +346,55 @@ class ShardedTrainer:
         def _discard_pending():
             # hook protocol: after an external restore overwrites the
             # model tree, drop any deferred unstack so it cannot
-            # clobber the restored weights (parallel/checkpoint.py)
+            # clobber the restored weights (parallel/checkpoint.py) —
+            # and schedule the INVERSE: the next pipelined step must
+            # restack the restored per-layer tree into the pipe-sharded
+            # params/opt before it runs (fit(resume=True) / rollback)
             tr = wr()
             if tr is not None:
                 tr._model_stale = False
+                tr._restack_needed = True
+
+        def _sync_opt():
+            # checkpoint-capture protocol (parallel/checkpoint.py): the
+            # pipeline optimizer state lives trainer-side in the
+            # pipe-sharded structure; copy it into model.opt_state so
+            # a checkpoint stores it (copies — the pipe step DONATES
+            # the live buffers, and an async orbax save must not read
+            # storage the next step reclaims)
+            tr = wr()
+            if tr is not None:
+                tr.model.opt_state = jax.tree_util.tree_map(
+                    jnp.array, tr._pipe_opt)
         _hook.discard_pending = _discard_pending
+        _hook.sync_opt = _sync_opt
         model._param_sync_hook = _hook
+
+    def _restack(self):
+        """(Re)build the pipe-axis-sharded ``_pipe_params``/``_pipe_opt``
+        from the model's per-layer trees — the inverse of
+        ``sync_model``.  Runs at init and lazily before the next step
+        after an external restore overwrote the model tree
+        (``fit(resume=True)``, BadStepPolicy rollback): the restored
+        optimizer state is adopted when it has the pipe structure
+        (i.e. the checkpoint came from a pipeline run, captured via the
+        hook's ``sync_opt``), re-placed onto the init-time shardings;
+        anything else (fresh model, params-only restore) gets freshly
+        initialized optimizer state."""
+        params = self._stack_and_place()
+        self._pipe_params = params
+        fresh_opt = self._updater.init_state(params)
+        restored = self.model.opt_state
+        if restored is not None and \
+                jax.tree_util.tree_structure(restored) == \
+                jax.tree_util.tree_structure(fresh_opt):
+            self._pipe_opt = jax.tree_util.tree_map(
+                lambda z, r: jax.device_put(jnp.asarray(r), z.sharding),
+                fresh_opt, restored)
+        else:
+            self._pipe_opt = fresh_opt
+        self._model_stale = False
+        self._restack_needed = False
 
     def _pipe_reg(self, params):
         """l1/l2 over all layers from the TRACED params — a sum over a
@@ -386,21 +436,36 @@ class ShardedTrainer:
         lo, hi = self._pipe
         m = self.model
         p = self._pipe_params
+        # COPIES, not views: the next pipelined step donates the live
+        # pre/post buffers, and the model tree (or an async checkpoint
+        # save holding it) must not reference reclaimed storage
         for name, tree in {**p["pre"], **p["post"]}.items():
-            m.params_tree[name] = tree
+            m.params_tree[name] = jax.tree_util.tree_map(jnp.array, tree)
         for j in range(hi - lo):
             m.params_tree[f"layer_{lo + j}"] = jax.tree_util.tree_map(
                 lambda a, _j=j: a[_j], p["blocks"])
 
     def _shard_batch(self, batch: dict) -> dict:
         """Place every batch leaf (arrays, possibly nested per-input dicts
-        for multi-input graphs) batch-sharded over the 'data' axis."""
+        for multi-input graphs) batch-sharded over the 'data' axis.
+        Multi-process contract (the fleet workers): every process feeds
+        the IDENTICAL global batch; each assembles its own addressable
+        shards locally (``make_array_from_callback``) — ``device_put``
+        onto a cross-process sharding needs collective value checks the
+        CPU backend cannot run, and the data plane should not pay a
+        broadcast for bytes every host already holds."""
+        multi = jax.process_count() > 1
+
         def place(v):
-            v = jnp.asarray(v)
-            parts = [None] * v.ndim
-            if self.mesh_conf.data > 1 and v.ndim >= 1:
+            parts = [None] * np.ndim(v)
+            if self.mesh_conf.data > 1 and np.ndim(v) >= 1:
                 parts[0] = "data"
-            return jax.device_put(v, NamedSharding(self.mesh, P(*parts)))
+            sharding = NamedSharding(self.mesh, P(*parts))
+            if multi:
+                host = np.asarray(v)
+                return jax.make_array_from_callback(
+                    host.shape, sharding, lambda idx: host[idx])
+            return jax.device_put(jnp.asarray(v), sharding)
         return jax.tree_util.tree_map(place, batch)
 
     def _step_dict(self, batch: dict):
@@ -410,6 +475,11 @@ class ShardedTrainer:
         m = self.model
         tracer = telemetry.get_tracer()
         if self._pipe is not None:
+            if self._restack_needed:
+                # a restore overwrote the model tree since the last
+                # step (resume / rollback): rebuild the pipe-sharded
+                # params/opt from it before stepping
+                self._restack()
             if "features_mask" in batch or "labels_mask" in batch:
                 raise ValueError("pipeline path does not support "
                                  "masked batches yet")
@@ -464,12 +534,14 @@ class ShardedTrainer:
         ``resume=True`` restores the newest checkpoint from the
         attached ``CheckpointListener`` before training (run_fit
         semantics: ``n_epochs`` is then the TOTAL target) — the
-        preemption-recovery entry for sharded training."""
-        if resume and self._pipe is not None:
-            raise NotImplementedError(
-                "resume is not wired for the pipeline path yet: the "
-                "restored model tree must be restacked into the "
-                "pipe-sharded params (ROADMAP open item)")
+        preemption-recovery entry for sharded training.  On the
+        pipeline path the restored per-layer tree (and the pipe-
+        structured optimizer state the checkpoint captured via the
+        hook's ``sync_opt``) is restacked into the pipe-axis-sharded
+        ``_pipe_params``/``_pipe_opt`` before the first step — the
+        inverse of ``sync_model`` — preserving step/epoch/rng counters,
+        so pipeline kill-and-resume is bit-identical like the MLN
+        path."""
         out = run_fit(self.model, iterator, n_epochs, self._step_dict,
                       resume=resume)
         self.sync_model()

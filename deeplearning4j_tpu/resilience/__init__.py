@@ -25,6 +25,8 @@ Every recovery event lands in the PR-1 telemetry registry:
 ``serve_watchdog_restarts_total``, ``server_healthy``,
 ``retry_{attempts,backoff_seconds}{op=}``.
 """
+from deeplearning4j_tpu.resilience.coordination import (
+    FleetCoordinator, fleet_resume_fit)
 from deeplearning4j_tpu.resilience.errors import (
     CancelledError, DeadlineExceededError, InjectedFault,
     RetryableServerError, TrainingPreempted)
@@ -41,6 +43,7 @@ __all__ = [
     "InjectedFault", "TrainingPreempted", "RetryableServerError",
     "DeadlineExceededError", "CancelledError",
     "BadStepPolicy",
+    "FleetCoordinator", "fleet_resume_fit",
     "PreemptionGuard", "auto_resume_fit", "request_preemption",
     "preemption_requested", "clear_preemption",
     "retry_call", "backoff_delay",

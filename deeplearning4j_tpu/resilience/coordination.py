@@ -1,0 +1,221 @@
+"""Coordinated cross-host restart for a ``jax.distributed`` fleet.
+
+PR 3's resilience layer is per-process: a SIGTERM checkpoints *this*
+process at *its* next step boundary.  In a multi-host job that is not
+enough — the cluster manager preempts ONE worker, the others never see
+a signal, and the fleet dies mid-collective with its checkpoints at
+mismatched steps (the failure mode the TPU-supercomputer retrospective
+[PAPERS.md, arxiv 2606.15870] calls out: fleet-level incidents need
+fleet-level checkpoint-restart).  This module adds the two coordinated
+pieces:
+
+* **In-band preemption broadcast** — :class:`FleetCoordinator` installs
+  itself as the step-boundary preemption poll (``resilience.preemption``)
+  and or-reduces the local flag over the global mesh: a tiny ``[1]``-per-
+  device int32 all-reduce piggybacked between training steps
+  (``parallel.distributed.or_reduce_flag``), so every rank learns of any
+  rank's SIGTERM at the SAME step boundary and the forced final
+  checkpoints all carry the SAME step label.  No second transport: the
+  control bit rides the data plane the gradients already cross.
+
+* **Elect-and-rendezvous restart** — :func:`fleet_resume_fit`
+  generalizes ``auto_resume_fit`` to N processes: before (re-)entering
+  the fit, every rank passes a rendezvous barrier (a sum-reduce that
+  blocks until the whole fleet has re-``initialize()``-ed into the
+  coordinator and proves the expected world size), then agrees on the
+  newest COMMON checkpoint (min-reduce of each rank's newest step;
+  ranks discard anything newer, e.g. a final save that landed on some
+  hosts but not others) — only then do collectives resume, so no rank
+  re-enters training against peers replaying a different step.
+
+Telemetry: ``fleet_preempt_broadcasts_total`` (step-boundary or-reduces
+that came back "preempt"), ``fleet_resumes_total`` (fleet re-entries
+that agreed on a resume checkpoint).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Tuple, Type
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.resilience import preemption as _preemption
+from deeplearning4j_tpu.resilience.errors import TrainingPreempted
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+FLEET_BROADCASTS = telemetry.counter(
+    "fleet_preempt_broadcasts_total",
+    "step-boundary preemption-flag all-reduces that returned 'preempt' "
+    "(each rank counts the broadcast it acted on)")
+FLEET_RESUMES = telemetry.counter(
+    "fleet_resumes_total",
+    "fleet fit (re-)entries that rendezvoused and agreed on a resume "
+    "checkpoint step")
+
+
+class FleetCoordinator:
+    """Fleet-wide preemption propagation + restart rendezvous over a
+    device mesh (the training mesh, flattened; or all devices).
+
+    >>> with FleetCoordinator(trainer.mesh):
+    ...     trainer.fit(it, n_epochs=5)     # any rank's SIGTERM now
+    ...                                     # checkpoints EVERY rank at
+    ...                                     # the same step
+
+    As a context manager it installs itself as ``run_fit``'s
+    step-boundary preemption poll; :func:`fleet_resume_fit` composes it
+    with restart supervision.  All methods that reduce are COLLECTIVE:
+    every process must call them at the same point, which the
+    synchronous training loop guarantees for :meth:`poll` and the
+    restart protocol guarantees for :meth:`rendezvous` /
+    :meth:`agree_resume_step`.
+    """
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+        self._previous = None
+
+    # -- in-band flag broadcast ----------------------------------------
+    def poll(self, local_flag: bool) -> bool:
+        """Or-reduce the local preemption flag over the fleet; when the
+        fleet says preempt, arm the LOCAL flag too so the forced
+        checkpoint-and-unwind path runs identically on every rank."""
+        from deeplearning4j_tpu.parallel import distributed
+        fleet_flag = distributed.or_reduce_flag(local_flag, self.mesh)
+        if fleet_flag:
+            FLEET_BROADCASTS.inc()
+            if not local_flag:
+                log.warning("fleet preemption broadcast received: a "
+                            "peer rank is preempted; checkpointing at "
+                            "this step boundary")
+                _preemption.request_preemption()
+        return fleet_flag
+
+    # -- restart protocol ----------------------------------------------
+    def rendezvous(self) -> int:
+        """Barrier gating re-entry into collectives: blocks until every
+        process has dispatched, and proves the reassembled world is the
+        expected size (a half-restarted fleet must not resume training
+        on a partial mesh).  Returns the device total."""
+        import jax
+        from deeplearning4j_tpu.parallel import distributed
+        expected = (self.mesh.size if self.mesh is not None
+                    else jax.device_count())
+        # every device contributes a 1: the sum is the world size, and
+        # the dispatch itself is the barrier (the collective cannot
+        # complete until every process has issued it)
+        total = distributed.sum_reduce(1, self.mesh)
+        if total != expected:
+            raise RuntimeError(
+                f"fleet rendezvous saw {total} devices, expected "
+                f"{expected} — a rank re-initialized with a different "
+                "topology")
+        return total
+
+    def agree_resume_step(self, checkpoint) -> Optional[int]:
+        """Newest-common-checkpoint agreement: each rank offers its
+        newest step, the fleet min-reduces, and every rank DISCARDS
+        checkpoints newer than the agreed step (a forced final save
+        that landed on some hosts but not others must not desync the
+        restore) so the subsequent ``restore_latest``/``resume=True``
+        restores the same step everywhere.  ``checkpoint`` is a
+        ``CheckpointListener`` or ``ShardedCheckpointer``.  Returns the
+        agreed step, or None when no rank has a full set."""
+        ck = getattr(checkpoint, "ckpt", checkpoint)
+        from deeplearning4j_tpu.parallel import distributed
+        steps = sorted(int(s) for s in ck.all_steps())
+        newest = steps[-1] if steps else -1
+        agreed = distributed.min_reduce(newest, self.mesh)
+        if agreed < 0:
+            # some rank has NOTHING (replaced node, wiped disk): the
+            # fresh start must be fleet-wide — a rank quietly resuming
+            # its local step N against fresh-start peers is exactly
+            # the desync this agreement exists to prevent
+            for s in steps:
+                log.warning("fleet agreement: discarding local "
+                            "checkpoint step %d (a peer has no "
+                            "checkpoints; fleet fresh-starts)", s)
+                ck.delete_step(s)
+            log.info("fleet agreement: no common checkpoint "
+                     "(fresh start)")
+            return None
+        if agreed not in steps:
+            raise RuntimeError(
+                f"fleet agreement: agreed step {agreed} is missing "
+                f"locally (have {steps}) — checkpoint retention "
+                "rotated it out; raise keep_last")
+        for s in steps:
+            if s > agreed:
+                log.warning("fleet agreement: discarding local "
+                            "checkpoint step %d > agreed %d (not "
+                            "fleet-complete)", s, agreed)
+                ck.delete_step(s)
+        FLEET_RESUMES.inc()
+        log.info("fleet agreement: resuming from common checkpoint "
+                 "step %d", agreed)
+        return agreed
+
+    # -- scoped install -------------------------------------------------
+    def __enter__(self):
+        self._previous = _preemption.install_coordinator(self)
+        return self
+
+    def __exit__(self, *exc):
+        _preemption.install_coordinator(self._previous)
+        self._previous = None
+        return False
+
+
+def fleet_resume_fit(fit_fn: Callable, mesh=None, checkpoint=None,
+                     max_restarts: int = 3,
+                     retry_on: Tuple[Type[BaseException], ...] = ()):
+    """``auto_resume_fit`` generalized to a ``jax.distributed`` fleet:
+    run ``fit_fn`` (a zero-arg callable driving a RESUMABLE fit, i.e.
+    one that passes ``resume=True`` with a ``CheckpointListener``
+    attached) to completion across coordinated preemptions.
+
+    Every (re-)entry is gated by the restart protocol — rendezvous
+    barrier, then newest-common-checkpoint agreement on ``checkpoint``
+    (when given) — and runs under an installed
+    :class:`FleetCoordinator`, so any rank's preemption during the fit
+    checkpoints the WHOLE fleet at one step.  On a true process death
+    the surviving collective hangs and the cluster manager restarts
+    the job: the fresh processes call ``distributed.initialize()``
+    (coordinator re-election is jax's: the restarted coordinator
+    rebinds the same address) and land back here, where the barrier
+    holds them until the fleet is whole and the agreement picks the
+    step every rank can restore.
+
+    >>> distributed.initialize()
+    >>> trainer = ShardedTrainer(model, mesh_conf)
+    >>> ck = CheckpointListener(shared_dir, save_every_n_iterations=50)
+    >>> model.set_listeners(ck)
+    >>> fleet_resume_fit(
+    ...     lambda: trainer.fit(it, n_epochs=10, resume=True),
+    ...     mesh=trainer.mesh, checkpoint=ck)
+    """
+    coordinator = FleetCoordinator(mesh)
+    restarts = 0
+    with coordinator:
+        while True:
+            coordinator.rendezvous()
+            if checkpoint is not None:
+                coordinator.agree_resume_step(checkpoint)
+            try:
+                return fit_fn()
+            except TrainingPreempted as e:
+                _preemption.clear_preemption()
+                restarts += 1
+                if restarts > max_restarts:
+                    raise
+                log.warning("fleet preempted at checkpoint step %s; "
+                            "restart %d/%d rendezvouses and resumes",
+                            e.step, restarts, max_restarts)
+            except retry_on as e:              # pragma: no branch
+                _preemption.clear_preemption()
+                restarts += 1
+                if restarts > max_restarts:
+                    raise
+                log.warning("fleet fit failed (%s: %s); restart %d/%d "
+                            "resumes from the agreed checkpoint",
+                            type(e).__name__, e, restarts, max_restarts)

@@ -234,3 +234,51 @@ def maybe_stall(kind: str, index: Optional[int] = None) -> float:
 def corrupt_batch(index: Optional[int], batch: dict) -> dict:
     inj = active()
     return inj.corrupt_batch(index, batch) if inj is not None else batch
+
+
+# -- chaos-scenario helpers (shared by scripts/chaos_smoke.py and the
+# recovery tests, so the scheduler-throttling recipes and the
+# KV-poisoning protocol live in ONE place) ----------------------------------
+
+def throttled_stall_plan(n_throttles: int, final: str,
+                         enqueue_s: float = 0.3,
+                         throttle_s: float = 0.05) -> List[str]:
+    """The serve-chaos pass recipe: pass 0 stalls ``enqueue_s`` (every
+    concurrent submit enqueues before the first admission), passes
+    1..n_throttles throttle ``throttle_s`` each (slots fill and decode
+    a few ticks without draining their budgets), then ``final`` — a
+    ``serve_tick_fail@K`` crash or a past-deadline ``serve_tick_stall``
+    hang at index ``n_throttles + 1``."""
+    return ([f"serve_tick_stall@0:{enqueue_s:g}"] +
+            [f"serve_tick_stall@{k}:{throttle_s:g}"
+             for k in range(1, n_throttles + 1)] + [final])
+
+
+def poison_slot_kv(server, slot: int, timeout_s: float = 10.0) -> bool:
+    """NaN-poison one slot's KV row in a live ``GenerationServer`` —
+    the deterministic stand-in for device memory corruption the
+    salvage path's finiteness screen must catch.  The tick dispatch
+    donates the pool (honored even on CPU), so a write can hit a
+    consumed buffer or be overwritten by an in-flight commit: retry
+    until the NaN verifiably sticks in the COMMITTED pool."""
+    import jax.numpy as jnp
+    import numpy as np
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with server._lock:
+                kc = server._kc
+                if not kc.is_deleted():
+                    server._kc = kc.at[:, slot, :, 0, :].set(jnp.nan)
+        except RuntimeError:
+            pass
+        time.sleep(0.12)              # > one throttled scheduler pass
+        try:
+            with server._lock:
+                kc = server._kc
+                if not kc.is_deleted() and bool(np.isnan(
+                        np.asarray(kc)[:, slot]).any()):
+                    return True
+        except RuntimeError:
+            pass
+    return False

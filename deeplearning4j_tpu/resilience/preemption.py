@@ -35,6 +35,34 @@ RESUMES = telemetry.counter(
 
 _FLAG = threading.Event()
 
+# Installed FleetCoordinator (resilience/coordination.py), or None.
+# With one installed, run_fit's step-boundary poll or-reduces the flag
+# over the whole jax.distributed fleet, so every rank sees a peer's
+# SIGTERM at the SAME step and checkpoints coordinately.
+_COORDINATOR = None
+
+
+def install_coordinator(coordinator):
+    """Install (None: remove) the fleet preemption coordinator consulted
+    by :func:`poll_preemption`; returns the previous one (scoped install
+    — ``FleetCoordinator.__enter__`` uses it)."""
+    global _COORDINATOR
+    previous = _COORDINATOR
+    _COORDINATOR = coordinator
+    return previous
+
+
+def poll_preemption() -> bool:
+    """The step-boundary check ``run_fit`` makes: the local flag alone,
+    or — with a :class:`FleetCoordinator` installed — the flag or-reduced
+    over every process in the fleet, so all ranks answer identically at
+    the same boundary (a collective: every rank must poll in lockstep,
+    which the synchronous training loop guarantees)."""
+    coordinator = _COORDINATOR
+    if coordinator is None:
+        return _FLAG.is_set()
+    return coordinator.poll(_FLAG.is_set())
+
 
 def request_preemption(signum=None, frame=None) -> None:
     """Set the preemption flag — the signal handler body, also called

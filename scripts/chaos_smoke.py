@@ -2,19 +2,26 @@
 """Chaos smoke — the resilience-layer CI gate.
 
 Fires every :data:`deeplearning4j_tpu.resilience.FAULT_KINDS` injector
-kind exactly once against a real (tiny, CPU-sized) training run and a
-real ``GenerationServer``, then asserts:
+kind against a real (tiny, CPU-sized) training run and a real
+``GenerationServer``, then asserts:
 
 * training still completes with the uninterrupted run's EXACT final
   loss and parameters (kill-and-resume is bit-identical; NaN steps are
   skipped; a failed checkpoint write degrades, not kills);
-* the decode server survives a scheduler crash AND a hung tick via the
-  watchdog, and a retried submit returns offline-identical greedy
-  output;
+* a PIPELINE trainer preempted under ``fleet_resume_fit`` rendezvouses,
+  agrees a resume step, restacks the restored tree into the
+  pipe-sharded params and finishes (coordinated-restart + pipeline
+  resume, in the single-process degenerate);
+* decode-server recovery is ZERO-DOWNTIME: a scheduler crash salvages
+  every in-flight slot's KV (all callers complete byte-identically,
+  nothing resubmitted), and a stuck tick with a poisoned slot drops
+  ONLY that slot — the two unaffected callers finish offline-identical
+  and the implicated one rides a submit retry through;
 * every recovery event landed in the telemetry registry
   (``faults_injected_total{kind=...}`` for each kind, resume/preempt/
-  bad-step/watchdog counters, submit retry histograms) — checked over
-  a real HTTP scrape via the helpers in ``check_telemetry.py``.
+  bad-step/watchdog counters, ``fleet_*`` + ``kv_slots_*`` counters,
+  submit retry histograms) — checked over a real HTTP scrape via the
+  helpers in ``check_telemetry.py``.
 
 Runs on CPU inside the tier-1 budget — wired into
 ``tests/test_resilience.py::test_chaos_smoke`` un-marked, and runnable
@@ -27,6 +34,16 @@ import json
 import os
 import sys
 import tempfile
+import threading
+import time
+
+# the pipeline chaos run needs >= 2 devices; force a virtual CPU pair
+# BEFORE jax initializes (no-op in-process under tests/conftest.py,
+# which already forces 8)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -37,6 +54,17 @@ import numpy as np
 # 3-epoch x 6-batch run (18 iterations; checkpoints every 2)
 TRAIN_PLAN = ["data_stall@1:0.05", "nan_loss@3", "checkpoint_fail@4",
               "step_exception@7", "preempt@12"]
+# serving scenario 1 — scheduler crash mid-service: enqueue window,
+# 4 throttled passes (every slot fills and decodes a few ticks), then
+# pass 5 kills the scheduler thread.  Scenario 2 — stuck tick with a
+# poisoned slot: 15 throttled passes (budgets stay un-drained while
+# the main thread NaN-poisons the victim's KV row), then pass 16
+# hangs past the 0.8s deadline -> watchdog salvage recovery.
+from deeplearning4j_tpu.resilience.faults import (poison_slot_kv,
+                                                  throttled_stall_plan)
+
+SERVE_CRASH_PLAN = throttled_stall_plan(4, "serve_tick_fail@5")
+SERVE_STALL_PLAN = throttled_stall_plan(15, "serve_tick_stall@16:2.2")
 
 
 def _load_check_telemetry():
@@ -149,39 +177,132 @@ def main() -> int:
             f"preempt+resume final loss {loss2} != uninterrupted "
             f"{ref_loss} (kill-and-resume not bit-identical)")
 
-    # -- serving fault matrix ------------------------------------------
+    # -- preempt-in-pipeline: coordinated fleet restart + pipeline
+    # resume (single-process degenerate of the multiproc chaos test) --
+    import jax
+    fleet_b0 = counter("fleet_preempt_broadcasts_total").value
+    fleet_r0 = counter("fleet_resumes_total").value
+    if jax.device_count() < 2:
+        problems.append(f"pipeline chaos run needs >= 2 devices, have "
+                        f"{jax.device_count()}")
+    else:
+        from deeplearning4j_tpu.parallel.mesh import MeshConfig
+        from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+        from deeplearning4j_tpu.resilience import fleet_resume_fit
+        rng_p = np.random.default_rng(4)
+        px = rng_p.integers(0, 32, (16, 8)).astype(np.int32)
+        py = np.roll(px, -1, axis=1)
+        gpt_p = Gpt(vocab_size=32, max_len=8, d_model=16, n_layers=2,
+                    n_heads=2, d_ff=32, seq_len=8, compute_dtype=None,
+                    use_flash=False, seed=9).init_graph()
+        tr_p = ShardedTrainer(gpt_p, MeshConfig(pipeline=2), n_micro=2)
+
+        def data_p():
+            return ListDataSetIterator(DataSet(px, py).batch_by(8))
+
+        with tempfile.TemporaryDirectory() as d:
+            ck_p = CheckpointListener(os.path.join(d, "ck"),
+                                      save_every_n_iterations=2)
+            gpt_p.set_listeners(ck_p)
+            with FaultInjector(["preempt@2"]):
+                loss_p = fleet_resume_fit(
+                    lambda: tr_p.fit(data_p(), n_epochs=2, resume=True),
+                    mesh=tr_p.mesh, checkpoint=ck_p, max_restarts=2)
+            ck_p.ckpt.close()
+        if gpt_p.epoch_count != 2:
+            problems.append(f"pipeline chaos run finished "
+                            f"{gpt_p.epoch_count}/2 epochs")
+        if loss_p is None or not np.isfinite(loss_p):
+            problems.append(f"pipeline post-preempt loss {loss_p}")
+        if counter("fleet_preempt_broadcasts_total").value - fleet_b0 < 1:
+            problems.append("fleet_preempt_broadcasts_total did not grow")
+        if counter("fleet_resumes_total").value - fleet_r0 < 1:
+            problems.append("fleet_resumes_total did not grow")
+
+    # -- serving fault matrix: zero-downtime KV salvage ----------------
     wd0 = counter("serve_watchdog_restarts_total").value
+    salv0 = counter("kv_slots_salvaged_total").value
+    drop0 = counter("kv_slots_dropped_total").value
     gpt = Gpt(vocab_size=50, max_len=32, d_model=32, n_layers=2,
               n_heads=4, d_ff=64, seq_len=8, compute_dtype=None,
               seed=3).init_graph()
     offline = TransformerGenerator(gpt)
     p = np.asarray([1, 2, 3, 4], np.int32)
-    ref_out = offline.generate(p[None], n_new=6)[0]
 
-    # one server takes both hits in sequence: (1) a scheduler crash —
-    # the worker thread dies mid-service, the watchdog fails in-flight
-    # callers retryably and restarts admission; (2) a hung tick — the
-    # stall exceeds tick_timeout_s, the watchdog fences the stuck
-    # scheduler out; each time the blocking submit retries through.
-    # tick_batch=1 pins the single-tick watchdog deadline this matrix
-    # injects against (a fused K-tick scan legitimately stretches the
-    # deadline by K and would absorb the stall as a slow scan).
-    with GenerationServer(gpt, n_slots=2, max_len=32, tick_timeout_s=0.8,
+    # one 3-slot server takes both hits in sequence.  tick_batch=1
+    # pins the single-tick watchdog deadline this matrix injects
+    # against (a fused K-tick scan legitimately stretches the deadline
+    # by K and would absorb the stall as a slow scan).
+    with GenerationServer(gpt, n_slots=3, max_len=32, tick_timeout_s=0.8,
                           tick_batch=1,
                           submit_retries=4, retry_backoff_s=0.02) as srv:
         srv.submit(p, n_new=2, timeout=300)          # warm the compiles
-        with FaultInjector(["serve_tick_fail@0"]):
-            out = srv.submit(p, n_new=6, timeout=300)
-        if not np.array_equal(out, ref_out):
-            problems.append("post-crash-recovery output mismatch")
+
+        # (1) scheduler crash with three requests mid-decode: the
+        # watchdog salvages ALL slots' KV into the rebuilt pool — every
+        # caller completes without resubmission, byte-identical
+        ref24 = offline.generate(p[None], n_new=24)[0]
+        with FaultInjector(SERVE_CRASH_PLAN):
+            hs = [srv.submit_async(p, n_new=24) for _ in range(3)]
+            for i, h in enumerate(hs):
+                try:
+                    if not np.array_equal(h.result(timeout=300), ref24):
+                        problems.append(
+                            f"post-crash salvage output {i} mismatch")
+                except Exception as e:
+                    problems.append(f"crash-salvaged request {i} "
+                                    f"failed: {e}")
+        if counter("kv_slots_salvaged_total").value - salv0 != 3:
+            problems.append("crash recovery salvaged != 3 slots")
+        if counter("kv_slots_dropped_total").value - drop0 != 0:
+            problems.append("crash recovery dropped a slot")
         if not srv.healthy():
             problems.append("server not healthy after crash recovery")
-        with FaultInjector(["serve_tick_stall@0:1.8"]):
-            out = srv.submit(p, n_new=6, timeout=300)
-        if not np.array_equal(out, ref_out):
-            problems.append("post-stall-recovery output mismatch")
-    if counter("serve_watchdog_restarts_total").value - wd0 < 2:
-        problems.append("expected >= 2 watchdog restarts (crash + stall)")
+
+        # (2) stuck tick with 2 live + 1 poisoned slot: recovery drops
+        # ONLY the poisoned slot (its caller retries through); the two
+        # unaffected callers finish offline-identical, un-resubmitted
+        salv1 = counter("kv_slots_salvaged_total").value
+        drop1 = counter("kv_slots_dropped_total").value
+        ref20 = offline.generate(p[None], n_new=20)[0]
+        victim_out = {}
+        with FaultInjector(SERVE_STALL_PLAN):
+            h0 = srv.submit_async(p, n_new=20)
+            h1 = srv.submit_async(p, n_new=20)
+            vt = threading.Thread(target=lambda: victim_out.update(
+                v=srv.submit(p, n_new=20, timeout=300, retries=4)))
+            vt.start()                    # third admission -> slot 2
+            for _ in range(2000):
+                with srv._lock:
+                    n_act = len(srv._active)
+                if n_act == 3:
+                    break
+                time.sleep(0.005)
+            if n_act != 3:
+                problems.append(f"stall scenario admitted {n_act}/3")
+            with srv._lock:               # the victim thread's slot is
+                victim_slot = [s for s, r in srv._active.items()
+                               if r not in (h0, h1)][0]
+            if not poison_slot_kv(srv, victim_slot):
+                problems.append("could not poison the victim's KV row")
+            for i, h in enumerate((h0, h1)):
+                try:
+                    if not np.array_equal(h.result(timeout=300), ref20):
+                        problems.append(
+                            f"post-stall salvage output {i} mismatch")
+                except Exception as e:
+                    problems.append(f"stall-salvaged request {i} "
+                                    f"failed: {e}")
+            vt.join(timeout=300)
+        if not np.array_equal(victim_out.get("v"), ref20):
+            problems.append("poisoned slot's retried submit mismatch")
+        if counter("kv_slots_salvaged_total").value - salv1 != 2:
+            problems.append("stall recovery salvaged != 2 slots")
+        if counter("kv_slots_dropped_total").value - drop1 != 1:
+            problems.append("stall recovery dropped != 1 slot")
+    if counter("serve_watchdog_restarts_total").value - wd0 != 2:
+        problems.append("expected exactly 2 watchdog restarts "
+                        "(crash + stall)")
 
     # -- sanitizer: one deliberate nan trip so the series has a
     # labeled child on the wire (check_finite itself is unconditional
@@ -196,9 +317,14 @@ def main() -> int:
     # -- static analysis: lint series on the wire ----------------------
     ct.emit_analysis_series(problems)
 
-    # -- every kind fired (preempt twice: matrix + bit-identical run) --
+    # -- every kind fired (preempt thrice: matrix + bit-identical run
+    # + pipeline fleet run; every scheduled serve stall throttled a
+    # scheduler pass) --
     expected = {k: 1 for k in resilience.FAULT_KINDS}
-    expected["preempt"] = 2
+    expected["preempt"] = 3
+    expected["serve_tick_stall"] = sum(
+        s.startswith("serve_tick_stall")
+        for s in SERVE_CRASH_PLAN + SERVE_STALL_PLAN)
     for k in resilience.FAULT_KINDS:
         delta = fault_counter.labels(kind=k).value - faults_before[k]
         if delta != expected[k]:
@@ -211,6 +337,19 @@ def main() -> int:
     required += [f'faults_injected_total{{kind="{k}"}}'
                  for k in resilience.FAULT_KINDS]
     required += ["retry_attempts_bucket", "retry_backoff_seconds_bucket"]
+    # the fleet/salvage counters must carry the REAL recovery values on
+    # the wire, not just exist
+    for needle in ("fleet_preempt_broadcasts_total",
+                   "fleet_resumes_total", "kv_slots_salvaged_total",
+                   "serve_watchdog_restarts_total"):
+        for line in body.splitlines():
+            if line.startswith(needle + " "):
+                if float(line.rsplit(" ", 1)[1]) <= 0:
+                    problems.append(f"{needle} scraped as 0 after "
+                                    "recoveries ran")
+                break
+        else:
+            problems.append(f"{needle} missing from the scrape")
     required += ct.ANALYSIS_SERIES
     required += ['sanitizer_trips_total{mode="nan"}']
     problems += ct.missing_series(body, required)

@@ -48,6 +48,14 @@ RESILIENCE_SERIES = [
     "generation_server_tick_failures_total",
     "generation_server_deadline_exceeded_total",
     "generation_server_cancelled_total",
+    # zero-downtime fleet layer: coordinated cross-host restart
+    # (resilience/coordination.py) and surgical KV salvage
+    # (generation_server pool recovery) — chaos_smoke asserts the
+    # values after firing real recoveries
+    "fleet_preempt_broadcasts_total",
+    "fleet_resumes_total",
+    "kv_slots_salvaged_total",
+    "kv_slots_dropped_total",
 ]
 
 # Static-analysis subsystem series: the lint counter gets labeled

@@ -547,6 +547,29 @@ class TestSanitizer:
             with pytest.raises(SanitizerError, match="iteration 1"):
                 m.fit(it, n_epochs=1, async_prefetch=False)
 
+    def test_solver_donate_site_is_ledger_checked(self, san_env):
+        # the solver step is a hooked donate site: training under
+        # donation mode passes (the loop rebinds to the step outputs),
+        # and re-using a PRE-step tree afterwards trips the ledger
+        from deeplearning4j_tpu import (MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf.layers_core import OutputLayer
+
+        conf = (NeuralNetConfiguration.builder().seed(3).list()
+                .layer(OutputLayer(n_in=4, n_out=2,
+                                   activation="softmax", loss="mcxent"))
+                .build())
+        m = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1] * 4]
+        san_env("donation")
+        m.fit(DataSet(x, y))                       # rebinds cleanly
+        stale = m.params_tree                      # tree the NEXT step
+        m.fit(DataSet(x, y))                       # donates
+        with pytest.raises(SanitizerError, match="solver/step"):
+            sanitize.check_not_donated("use", stale)
+
 
 # ---------------------------------------------------------------------------
 # rewrite shape-parity check (DL4J_TPU_REWRITE_CHECK)

@@ -77,3 +77,34 @@ def test_causal_fused_graph_finetunes_via_flash_route(fused_sd):
     assert losses[-1] < losses[0]
     routes = kernels.route_log()
     assert ("flash", 512, 32) in routes, routes
+
+
+def test_fold_causal_masks_opt_out_keeps_bias_operand():
+    """``optimize_for_tpu(..., fold_causal_masks=False)`` (a caller
+    fine-tuning the mask): the triangular constant stays an explicit
+    4th operand tagged ``bias_layout="qk"`` (a square [t, t] bias must
+    not be misread as the kernel's 2-D [b, tk] padding-mask
+    convention), ``causal`` stays False, and the kept-bias lowering
+    computes exactly the causal path's numbers."""
+    sd = import_frozen_pb(PB)
+    stats = optimize_for_tpu(sd, fold_causal_masks=False)
+    assert stats["attention"] == 2, stats
+    fused = [n for n in sd.ops if n.op_name == "fused_attention"]
+    assert len(fused) == 2
+    for n in fused:
+        assert n.attrs["causal"] is False
+        assert n.attrs["bias_layout"] == "qk"
+        assert len(n.inputs) == 4        # q, k, v, mask — kept
+
+    # numeric equivalence at small t (the CPU-safe XLA route): the
+    # declared [t, t] -1e9-triangular bias == causal=True
+    from deeplearning4j_tpu.autodiff.ops import OP_REGISTRY
+    fn = OP_REGISTRY["fused_attention"].fn
+    rng = np.random.default_rng(0)
+    q, k, v = (rng.normal(size=(2, 2, 8, 4)).astype(np.float32)
+               for _ in range(3))
+    mask = np.triu(np.full((8, 8), -1e9, np.float32), k=1)
+    kept = fn(q, k, v, bias=mask, bias_layout="qk", scale=0.5)
+    folded = fn(q, k, v, causal=True, scale=0.5)
+    np.testing.assert_allclose(np.asarray(kept), np.asarray(folded),
+                               atol=2e-6)

@@ -201,6 +201,79 @@ def test_four_process_preempt_nonzero_rank_and_resume(tmp_path):
                                    err_msg=f"step {k}")
 
 
+def _launch_fleet(port, out_dir, mode, phase, n_epochs=2, nproc=2,
+                  extra=()):
+    return [subprocess.Popen(
+        [sys.executable, os.path.join(WORKERS, "fleet_worker.py"),
+         str(rank), str(nproc), str(port), str(out_dir), mode,
+         str(n_epochs), phase, *extra],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for rank in range(nproc)]
+
+
+def _fleet_kill_mid_step(tmp_path, mode):
+    """Shared body: REAL SIGTERM to rank 1 mid-step -> the in-band flag
+    or-reduce checkpoints EVERY rank at the SAME step -> a fresh fleet
+    session rendezvouses, agrees the common checkpoint, and finishes
+    with byte-identical final params vs. the uninterrupted run."""
+    out = tmp_path / mode
+    out.mkdir()
+
+    # uninterrupted reference fleet
+    port = _free_port()
+    procs = _launch_fleet(port, out, mode, "ref")
+    for rank, p in enumerate(procs):
+        o = p.communicate(timeout=420)[0].decode()
+        assert p.returncode == 0, f"ref rank {rank}:\n{o[-3000:]}"
+        assert "FLEET_WORKER_OK" in o
+    ref = json.load(open(out / "ref_rank0.json"))
+
+    # preempted fleet: ONLY rank 1 receives the (self-delivered, real)
+    # SIGTERM; coordination must stop BOTH ranks at the same step
+    port = _free_port()
+    procs = _launch_fleet(port, out, mode, "preempt",
+                          extra=("--preempt-rank", "1",
+                                 "--preempt-iter", "3"))
+    for rank, p in enumerate(procs):
+        o = p.communicate(timeout=420)[0].decode()
+        assert p.returncode == 0, f"preempt rank {rank}:\n{o[-3000:]}"
+        assert "FLEET_PREEMPTED" in o
+    marks = [json.load(open(out / f"preempt_rank{r}.json"))
+             for r in range(2)]
+    assert marks[0]["step"] == marks[1]["step"] == 3, marks
+
+    # fresh fleet session resumes from the agreed common checkpoint
+    port = _free_port()
+    procs = _launch_fleet(port, out, mode, "resume")
+    for rank, p in enumerate(procs):
+        o = p.communicate(timeout=420)[0].decode()
+        assert p.returncode == 0, f"resume rank {rank}:\n{o[-3000:]}"
+        assert "FLEET_WORKER_OK" in o
+    res = json.load(open(out / "resume_rank0.json"))
+    assert res["final_iteration"] == ref["final_iteration"]
+    # the continuation replays the reference's loss trajectory exactly
+    for k, v in res["losses"].items():
+        np.testing.assert_allclose(v, ref["losses"][k], rtol=0,
+                                   atol=0, err_msg=f"step {k}")
+    # and the final parameters are BYTE-identical
+    assert res["params_sha"] == ref["params_sha"]
+
+
+@pytest.mark.slow
+def test_fleet_coordinated_preempt_and_resume_dp(tmp_path):
+    """2-process DP fleet: kill one worker mid-step (real SIGTERM),
+    coordinated checkpoint at one step, bit-identical fleet resume."""
+    _fleet_kill_mid_step(tmp_path, "dp")
+
+
+@pytest.mark.slow
+def test_fleet_coordinated_preempt_and_resume_pipeline(tmp_path):
+    """2-process PIPELINE fleet (stages span the process boundary):
+    the same kill-mid-step chaos, with the resume restacking the
+    restored tree into the pipe-sharded params."""
+    _fleet_kill_mid_step(tmp_path, "pipe")
+
+
 @pytest.mark.slow
 def test_eight_process_dp_tp_pp(tmp_path):
     """8 OS processes, 2x2x2 (data x model x pipeline) global mesh on
@@ -218,6 +291,11 @@ def test_eight_process_dp_tp_pp(tmp_path):
         env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for rank in range(8)]
     outs = [p.communicate(timeout=600)[0].decode() for p in procs]
+    if any("no jax.shard_map" in o for o in outs):
+        # the documented partial-auto gap: TP inside pipeline stages
+        # needs jax.shard_map with auto axes (see parallel/pipeline.py)
+        pytest.skip("this jax release cannot leave TP auto-partitioned "
+                    "inside pipeline stages (no jax.shard_map)")
     for rank, (p, o) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank}:\n{o[-3000:]}"
         assert "AXIS3_WORKER_OK" in o

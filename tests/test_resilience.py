@@ -358,37 +358,37 @@ def offline(net):
     "ignore::pytest.PytestUnhandledThreadExceptionWarning")
 def test_watchdog_recovers_scheduler_crash_concurrent_callers(net,
                                                               offline):
-    """An injected scheduler crash with requests mid-decode: every
-    concurrent caller gets a typed retryable error, the watchdog
-    rebuilds the pool, and a retried submit succeeds with
-    offline-identical greedy output."""
+    """An injected scheduler crash with requests mid-decode is now
+    ZERO-DOWNTIME: the watchdog salvages the unimplicated slots' KV
+    rows into the rebuilt pool and restarts the scheduler, so every
+    concurrent caller — two decoding, one queued — completes without
+    resubmission, byte-identical to offline decode."""
     from deeplearning4j_tpu.parallel import GenerationServer
     restarts = REG.counter("serve_watchdog_restarts_total")
-    w0 = restarts.value
+    salvaged = REG.counter("kv_slots_salvaged_total")
+    dropped = REG.counter("kv_slots_dropped_total")
+    w0, s0, d0 = restarts.value, salvaged.value, dropped.value
     p = np.asarray([1, 2, 3, 4], np.int32)
     with GenerationServer(net, n_slots=2, max_len=32,
                           tick_timeout_s=60) as srv:
         srv.submit(p, n_new=2, timeout=300)          # warm the compiles
-        # deterministic in-flight crash: pass 0 ingests the first
-        # request(s) and stalls 0.5s pre-tick (well under the 60s
-        # watchdog deadline), guaranteeing all three submits are
-        # enqueued; pass 1 ingests the rest and THEN hits the crash
-        # site — two decoding + one waiting, all mid-flight
-        with FaultInjector(["serve_tick_stall@0:0.5",
-                            "serve_tick_fail@1"]):
+        # deterministic in-flight crash: pass 0 stalls 0.3s (all three
+        # submits enqueue), passes 1-4 throttle 50ms each (both slots
+        # fill and decode a few ticks), pass 5 hits the crash site —
+        # two decoding + one waiting, all mid-flight; every stall is
+        # far under the 60s watchdog deadline
+        plan = (["serve_tick_stall@0:0.3"] +
+                [f"serve_tick_stall@{k}:0.05" for k in range(1, 5)] +
+                ["serve_tick_fail@5"])
+        with FaultInjector(plan):
             hs = [srv.submit_async(p, n_new=24) for _ in range(3)]
-            errs = 0
+            ref = offline.generate(p[None], n_new=24)[0]
             for h in hs:
-                try:
-                    h.result(timeout=300)
-                except RetryableServerError:
-                    errs += 1
-            assert errs == 3
-            # recovery: admission is open again and decode is exact
-            out = srv.submit(p, n_new=6, timeout=300)
-        np.testing.assert_array_equal(
-            out, offline.generate(p[None], n_new=6)[0])
+                np.testing.assert_array_equal(h.result(timeout=300),
+                                              ref)
         assert restarts.value - w0 == 1
+        assert salvaged.value - s0 == 2    # both decoding slots kept
+        assert dropped.value - d0 == 0     # nobody failed
         assert srv.healthy()
         assert srv._healthy.value == 1               # per-instance gauge
     assert not srv.healthy()                         # post-shutdown
@@ -479,6 +479,346 @@ def test_cancel_and_deadline_release_queue_entries(net, offline):
             offline.generate(p[None], n_new=6)[0])
         h1.result(timeout=300)
         assert h1.cancel() is False                  # already done
+
+
+@pytest.mark.slow  # tier-1 covers this scenario via test_chaos_smoke
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_watchdog_salvage_drops_only_poisoned_slot(net, offline):
+    """A stuck-tick watchdog restart with 2 live + 1 poisoned slot:
+    the two unaffected callers' outputs are byte-identical to offline
+    ``generate()`` without resubmission (kv_slots_salvaged_total == 2),
+    only the poisoned slot's caller fails retryably and rides a
+    submit retry through (kv_slots_dropped_total == 1)."""
+    import threading
+    from deeplearning4j_tpu.parallel import GenerationServer
+    from deeplearning4j_tpu.resilience.faults import (
+        poison_slot_kv, throttled_stall_plan)
+    salvaged = REG.counter("kv_slots_salvaged_total")
+    dropped = REG.counter("kv_slots_dropped_total")
+    s0, d0 = salvaged.value, dropped.value
+    p = np.asarray([1, 2, 3, 4], np.int32)
+    ref = offline.generate(p[None], n_new=26)[0]
+    # enqueue window; 15 throttled passes (budgets stay un-drained
+    # while the main thread poisons); then a 2.2s hang past the 0.8s
+    # single-tick deadline -> watchdog recovery
+    plan = throttled_stall_plan(15, "serve_tick_stall@16:2.2")
+    res = {}
+    with GenerationServer(net, n_slots=3, max_len=32, tick_timeout_s=0.8,
+                          tick_batch=1, submit_retries=4,
+                          retry_backoff_s=0.02) as srv:
+        srv.submit(p, n_new=2, timeout=300)          # warm the compiles
+        with FaultInjector(plan):
+            h0 = srv.submit_async(p, n_new=26)
+            h1 = srv.submit_async(p, n_new=26)
+            t = threading.Thread(target=lambda: res.update(
+                v=srv.submit(p, n_new=26, timeout=300, retries=4)))
+            t.start()                 # third admission -> slot 2
+            import time
+            for _ in range(2000):
+                with srv._lock:
+                    n = len(srv._active)
+                if n == 3:
+                    break
+                time.sleep(0.005)
+            assert n == 3
+            with srv._lock:           # the victim thread's slot
+                vslot = [s for s, r in srv._active.items()
+                         if r not in (h0, h1)][0]
+            assert poison_slot_kv(srv, vslot)
+            o0 = h0.result(timeout=300)
+            o1 = h1.result(timeout=300)
+            t.join(timeout=300)
+        np.testing.assert_array_equal(o0, ref)
+        np.testing.assert_array_equal(o1, ref)
+        np.testing.assert_array_equal(res["v"], ref)   # retried through
+    assert salvaged.value - s0 == 2
+    assert dropped.value - d0 == 1
+
+
+@pytest.mark.slow  # watchdog deadline wait; sibling of the test above
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_salvage_never_admits_a_staged_uncommitted_slot(net, offline):
+    """A request staged into ``_active`` whose prefill never COMMITTED
+    (the watchdog-takeover-mid-admission window, tracked in
+    ``_staged``) must NOT be salvaged — its KV rows are a previous
+    occupant's leftovers and 'salvaging' it would retire it as done
+    with the PREVIOUS request's bytes.  Recovery fails it retryably
+    and salvages the genuinely live slot.  Both slots are pre-used so
+    the staged slot holds a realistic retired state (pos > 0): the
+    host-side staging set, not device state, must catch it."""
+    from deeplearning4j_tpu.parallel import GenerationServer
+    from deeplearning4j_tpu.parallel.generation_server import _Pending
+    from deeplearning4j_tpu.resilience.faults import throttled_stall_plan
+    salvaged = REG.counter("kv_slots_salvaged_total")
+    dropped = REG.counter("kv_slots_dropped_total")
+    s0, d0 = salvaged.value, dropped.value
+    p = np.asarray([1, 2, 3, 4], np.int32)
+    ref = offline.generate(p[None], n_new=26)[0]
+    # enqueue window; 15 throttled passes (h0 stays live while the
+    # main thread stages the fake admission); then a hang past the
+    # deadline -> watchdog recovery
+    plan = throttled_stall_plan(15, "serve_tick_stall@16:2.2")
+    with GenerationServer(net, n_slots=2, max_len=32, tick_timeout_s=0.8,
+                          tick_batch=1) as srv:
+        # warm the compiles AND run a request through EVERY slot, so
+        # the ghost's slot carries a finished request's device state
+        wa = srv.submit_async(p, n_new=2)
+        wb = srv.submit_async(p, n_new=2)
+        wa.result(timeout=300), wb.result(timeout=300)
+        with FaultInjector(plan):
+            h0 = srv.submit_async(p, n_new=26)
+            import time
+            for _ in range(2000):
+                with srv._lock:
+                    n = len(srv._active)
+                if n == 1:
+                    break
+                time.sleep(0.005)
+            assert n == 1
+            # wait for the final 2.2s hang (in-flight tick age well
+            # past the 50ms throttles, before the 0.8s deadline), then
+            # stage an admission the scheduler will never prefill —
+            # the exact _active state the watchdog takeover sees when
+            # it fires between the staging lock and the prefill commit
+            staged = False
+            for _ in range(4000):
+                with srv._lock:
+                    started = srv._tick_started
+                if started is not None and \
+                        time.monotonic() - started[1] > 0.35:
+                    staged = True
+                    break
+                time.sleep(0.005)
+            assert staged
+            ghost = _Pending(p, 8, -1, 0)
+            with srv._lock:
+                gslot = srv._free.pop()
+                srv._active[gslot] = ghost     # what the scheduler's
+                srv._staged.add(gslot)         # staging block does
+            with pytest.raises(RetryableServerError):
+                ghost.result(timeout=300)            # dropped, typed
+            np.testing.assert_array_equal(h0.result(timeout=300), ref)
+    assert salvaged.value - s0 == 1                  # only the live slot
+    assert dropped.value - d0 == 1                   # the staged ghost
+    assert not np.array_equal(
+        np.zeros_like(ref), ref)                     # ref sanity
+
+
+@pytest.mark.slow  # watchdog deadline wait; sibling of the tests above
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_watchdog_recovery_survives_donation_sanitizer(net, offline,
+                                                       monkeypatch):
+    """DL4J_TPU_SANITIZE=donation + a tick that hung AFTER marking the
+    pool donated: the salvage path's ledger check trips, which must
+    DEMOTE recovery to the drop-all rebuild (caller fails retryably,
+    retry succeeds on the fresh pool) — not escape ``_recover`` and
+    kill the watchdog thread with every caller left hanging."""
+    import threading
+    from deeplearning4j_tpu.analysis import sanitize
+    from deeplearning4j_tpu.parallel import GenerationServer
+    from deeplearning4j_tpu.parallel.generation_server import _sanitize
+    from deeplearning4j_tpu.resilience.faults import throttled_stall_plan
+    monkeypatch.setenv("DL4J_TPU_SANITIZE", "donation")
+    sanitize.refresh()
+    try:
+        restarts = REG.counter("serve_watchdog_restarts_total")
+        dropped = REG.counter("kv_slots_dropped_total")
+        w0, d0 = restarts.value, dropped.value
+        p = np.asarray([1, 2, 3, 4], np.int32)
+        ref = offline.generate(p[None], n_new=26)[0]
+        res = {}
+        plan = throttled_stall_plan(15, "serve_tick_stall@16:2.2")
+        with GenerationServer(net, n_slots=1, max_len=32,
+                              tick_timeout_s=0.8, tick_batch=1,
+                              submit_retries=4,
+                              retry_backoff_s=0.02) as srv:
+            srv.submit(p, n_new=2, timeout=300)      # warm the compiles
+            with FaultInjector(plan):
+                t = threading.Thread(target=lambda: res.update(
+                    v=srv.submit(p, n_new=26, timeout=300, retries=4)))
+                t.start()
+                import time
+                for _ in range(2000):
+                    with srv._lock:
+                        n = len(srv._active)
+                    if n == 1:
+                        break
+                    time.sleep(0.005)
+                assert n == 1
+                # wait for the final 2.2s hang (tick age well past the
+                # 50ms throttles, before the 0.8s deadline), THEN mark:
+                # the hung-dispatch state — the tick marked the pool
+                # donated and blocked, so the COMMITTED pool objects
+                # are on the ledger when the WATCHDOG takes over (an
+                # earlier mark would trip the scheduler's own inline
+                # check instead)
+                marked = False
+                for _ in range(4000):
+                    with srv._lock:
+                        started = srv._tick_started
+                    if started is not None and \
+                            time.monotonic() - started[1] > 0.35:
+                        marked = True
+                        break
+                    time.sleep(0.005)
+                assert marked
+                with srv._lock:
+                    _sanitize.mark_donated("serve/tick", srv._kc,
+                                           srv._vc, srv._state)
+                t.join(timeout=300)
+            assert not t.is_alive()          # watchdog survived; the
+            np.testing.assert_array_equal(res["v"], ref)  # retry won
+            assert srv.healthy()
+        assert restarts.value - w0 >= 1
+        assert dropped.value - d0 >= 1       # drop-all demotion
+    finally:
+        monkeypatch.delenv("DL4J_TPU_SANITIZE", raising=False)
+        sanitize.refresh()
+        sanitize.ledger.reset()
+
+
+# ---------------------------------------------------------------------------
+# Fleet coordination (single-process degenerate; the multiproc fleet
+# kill test lives in test_distributed_multiproc.py, @slow)
+# ---------------------------------------------------------------------------
+def test_fleet_coordinator_propagates_flag_and_counts():
+    """poll() or-reduces the local flag over the (here: 1-process)
+    mesh, arms the local flag when the fleet says preempt, and counts
+    the broadcast; rendezvous proves the world size."""
+    from deeplearning4j_tpu.resilience.coordination import (
+        FLEET_BROADCASTS, FleetCoordinator)
+    import jax
+    c = FleetCoordinator()
+    assert c.rendezvous() == jax.device_count()
+    b0 = FLEET_BROADCASTS.value
+    assert c.poll(False) is False
+    assert FLEET_BROADCASTS.value == b0
+    with c:                        # installs the coordinated poll
+        from deeplearning4j_tpu.resilience import preemption
+        assert preemption.poll_preemption() is False
+        resilience.request_preemption()
+        assert preemption.poll_preemption() is True
+    assert FLEET_BROADCASTS.value - b0 == 1
+    assert resilience.preemption_requested()   # flag armed locally
+
+
+def test_fleet_agreement_discards_uncommon_steps(tmp_path, monkeypatch):
+    """Newest-common-checkpoint agreement: when a peer's newest step is
+    older (min-reduce returns 2 while we hold 2 and 4), the local
+    step-4 checkpoint is discarded so restore_latest lands on the
+    agreed step everywhere."""
+    from deeplearning4j_tpu.parallel import distributed
+    from deeplearning4j_tpu.resilience.coordination import (
+        FLEET_RESUMES, FleetCoordinator)
+    m = _model()
+    m._build_solver()
+    ck = CheckpointListener(tmp_path / "ck", save_every_n_iterations=1,
+                            keep_last=5)
+    m.set_listeners(ck)
+    rng = np.random.default_rng(0)
+    x, y = _data(rng, n=16)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    for _ in range(5):
+        m.fit(DataSet(x, y))
+    ck.ckpt.wait()
+    steps = ck.ckpt.all_steps()
+    assert 2 in steps and max(steps) > 2
+    monkeypatch.setattr(distributed, "min_reduce",
+                        lambda value, mesh=None: 2)
+    r0 = FLEET_RESUMES.value
+    agreed = FleetCoordinator().agree_resume_step(ck)
+    assert agreed == 2
+    assert max(ck.ckpt.all_steps()) == 2       # newer steps discarded
+    assert FLEET_RESUMES.value - r0 == 1
+    step, _ = ck.ckpt.restore_latest(ck._state(m))
+    assert step == 2
+    ck.ckpt.close()
+
+
+def test_fleet_resume_fit_preempt_bit_identical(tmp_path, rng):
+    """fleet_resume_fit in the 1-process degenerate: the supervisor's
+    rendezvous + agreement + coordinated poll wrap a preempted fit and
+    the completion is bit-identical to the uninterrupted run (the
+    N-process generalization of auto_resume_fit)."""
+    from deeplearning4j_tpu.resilience import fleet_resume_fit
+    x, y = _data(rng)
+    ref = _model()
+    ref_loss = ref.fit(_iter(x, y), n_epochs=3, async_prefetch=False)
+
+    m = _model()
+    ck = CheckpointListener(tmp_path / "ck", save_every_n_iterations=5)
+    m.set_listeners(ck)
+    resumes = REG.counter("fleet_resumes_total")
+    r0 = resumes.value
+    with FaultInjector(["preempt@8"]):
+        loss = fleet_resume_fit(
+            lambda: m.fit(_iter(x, y), n_epochs=3, async_prefetch=False,
+                          resume=True), checkpoint=ck)
+    ck.ckpt.close()
+    assert float(loss) == float(ref_loss)
+    assert resumes.value - r0 >= 1         # the restart agreed a step
+    for a, b in zip(_leaves(ref.params_tree), _leaves(m.params_tree)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-trainer resume (ShardedTrainer MeshConfig.pipeline > 1)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow  # 3 pipeline compiles; chaos_smoke covers resume in tier-1
+def test_pipeline_trainer_kill_and_resume_bit_identical(tmp_path):
+    """Pipeline-path kill-and-resume, mirroring the MLN test: preempt
+    mid-fit, restore into a FRESH trainer whose fit(resume=True)
+    restacks the checkpoint tree (params + pipe-structured optimizer
+    state + counters/rng) into the pipe-sharded params — final loss and
+    params bit-identical to the uninterrupted run."""
+    import jax
+    from deeplearning4j_tpu.parallel.mesh import MeshConfig
+    from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+    from deeplearning4j_tpu.zoo.gpt import Gpt
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterator import ListDataSetIterator
+
+    def mk():
+        return Gpt(vocab_size=48, max_len=12, d_model=16, n_layers=2,
+                   n_heads=2, d_ff=32, seq_len=12, compute_dtype=None,
+                   use_flash=False, seed=5).init_graph()
+
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 48, (24, 12)).astype(np.int32)
+    y = np.roll(x, -1, axis=1)
+
+    def it():
+        return ListDataSetIterator(DataSet(x, y).batch_by(8))
+
+    ref = mk()
+    tr_ref = ShardedTrainer(ref, MeshConfig(pipeline=2), n_micro=2)
+    ref_loss = tr_ref.fit(it(), n_epochs=2)
+
+    m = mk()
+    tr = ShardedTrainer(m, MeshConfig(pipeline=2), n_micro=2)
+    ck = CheckpointListener(tmp_path / "ck", save_every_n_iterations=2)
+    m.set_listeners(ck)
+    with pytest.raises(TrainingPreempted):
+        with FaultInjector(["preempt@3"]):
+            tr.fit(it(), n_epochs=2)
+    resilience.clear_preemption()
+
+    m2 = mk()
+    tr2 = ShardedTrainer(m2, MeshConfig(pipeline=2), n_micro=2)
+    ck2 = CheckpointListener(tmp_path / "ck")
+    m2.set_listeners(ck2)
+    loss2 = tr2.fit(it(), n_epochs=2, resume=True)
+    assert m2.iteration_count == ref.iteration_count == 6
+    assert float(loss2) == float(ref_loss)
+    tr2.sync_model()
+    tr_ref.sync_model()
+    for a, b in zip(_leaves(ref.params_tree), _leaves(m2.params_tree)):
+        np.testing.assert_array_equal(a, b)
+    ck.ckpt.close()
+    ck2.ckpt.close()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,155 @@
+"""Fleet-coordination chaos worker: N ``jax.distributed`` processes
+training under a ``FleetCoordinator``; a REAL SIGTERM to ONE rank
+mid-step must checkpoint EVERY rank at the same step (the in-band flag
+or-reduce), and a fresh fleet session resumes through
+``fleet_resume_fit`` (rendezvous + newest-common-checkpoint agreement)
+to a bit-identical finish — for both the DP and the PIPELINE trainer
+path.
+
+Usage: fleet_worker.py <rank> <nproc> <port> <out_dir> <mode:dp|pipe>
+       <n_epochs> <phase:ref|preempt|resume>
+       [--preempt-rank R --preempt-iter N]
+"""
+import hashlib
+import json
+import os
+import signal
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+(rank, nproc, port, out_dir, mode, n_epochs, phase) = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+    sys.argv[5], int(sys.argv[6]), sys.argv[7])
+preempt_rank = preempt_iter = None
+if "--preempt-rank" in sys.argv:
+    preempt_rank = int(sys.argv[sys.argv.index("--preempt-rank") + 1])
+    preempt_iter = int(sys.argv[sys.argv.index("--preempt-iter") + 1])
+
+from deeplearning4j_tpu.parallel import distributed  # noqa: E402
+
+distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                       num_processes=nproc, process_id=rank)
+assert jax.process_count() == nproc
+
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: E402
+    TrainingListener)
+from deeplearning4j_tpu.parallel.checkpoint import (  # noqa: E402
+    CheckpointListener)
+from deeplearning4j_tpu.parallel.mesh import MeshConfig  # noqa: E402
+from deeplearning4j_tpu.parallel.trainer import (  # noqa: E402
+    ShardedTrainer)
+from deeplearning4j_tpu.data.dataset import DataSet  # noqa: E402
+from deeplearning4j_tpu.data.iterator import (  # noqa: E402
+    ListDataSetIterator)
+from deeplearning4j_tpu.resilience import (  # noqa: E402
+    FleetCoordinator, PreemptionGuard, TrainingPreempted,
+    fleet_resume_fit)
+
+# identical model + identical global batches on every rank: the mesh
+# does the scatter, the losses replicate, and a resumed session replays
+# the same stream
+if mode == "dp":
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                        OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.builder().seed(11)
+            .updater(Adam(learning_rate=0.01)).list()
+            .layer(DenseLayer(n_in=6, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    trainer = ShardedTrainer(model, MeshConfig(data=nproc))
+    rng = np.random.default_rng(7)
+    gx = rng.normal(size=(24, 6)).astype(np.float32)
+    gy = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 24)]
+else:
+    from deeplearning4j_tpu.zoo.gpt import Gpt
+    model = Gpt(vocab_size=32, max_len=8, d_model=16, n_layers=2,
+                n_heads=2, d_ff=32, seq_len=8, compute_dtype=None,
+                use_flash=False, seed=9).init_graph()
+    trainer = ShardedTrainer(model, MeshConfig(pipeline=nproc),
+                             n_micro=2)
+    rng = np.random.default_rng(7)
+    gx = rng.integers(0, 32, (24, 8)).astype(np.int32)
+    gy = np.roll(gx, -1, axis=1)
+
+
+def data():
+    return ListDataSetIterator(DataSet(gx, gy).batch_by(8))
+
+
+losses = {}
+
+
+class _Recorder(TrainingListener):
+    def iteration_done(self, model, iteration, epoch, loss):
+        losses[iteration] = float(loss)
+
+
+class _SelfSigterm(TrainingListener):
+    """Deliver a REAL SIGTERM to THIS rank at a chosen iteration — the
+    cluster-manager preemption, deterministically timed."""
+
+    def iteration_done(self, model, iteration, epoch, loss):
+        if iteration == preempt_iter:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+listeners = [_Recorder()]
+ck = None
+if phase != "ref":
+    # sync saves: every rank participates in each multiprocess write
+    ck = CheckpointListener(os.path.join(out_dir, "ckpt"),
+                            save_every_n_iterations=2, async_save=False)
+    listeners.append(ck)
+if phase == "preempt" and rank == preempt_rank:
+    listeners.append(_SelfSigterm())
+model.set_listeners(*listeners)
+
+
+def dump(tag):
+    trainer.sync_model()
+    leaves = jax.tree_util.tree_leaves(model.params_tree)
+    h = hashlib.sha256()
+    for leaf in leaves:
+        h.update(np.ascontiguousarray(jax.device_get(leaf)).tobytes())
+    with open(os.path.join(out_dir, f"{tag}_rank{rank}.json"),
+              "w") as f:
+        json.dump({"rank": rank, "params_sha": h.hexdigest(),
+                   "losses": {str(k): v for k, v in losses.items()},
+                   "final_iteration": model.iteration_count}, f)
+
+
+if phase == "ref":
+    trainer.fit(data(), n_epochs=n_epochs)
+    dump("ref")
+    print("FLEET_WORKER_OK", rank)
+elif phase == "preempt":
+    try:
+        with PreemptionGuard(), FleetCoordinator(trainer.mesh):
+            trainer.fit(data(), n_epochs=n_epochs)
+        raise SystemExit(f"rank {rank}: fit finished without preemption")
+    except TrainingPreempted as e:
+        # the coordinated checkpoint landed; record ITS step — the
+        # parent asserts every rank stopped at the SAME one
+        with open(os.path.join(out_dir, f"preempt_rank{rank}.json"),
+                  "w") as f:
+            json.dump({"rank": rank, "step": e.step}, f)
+        print("FLEET_PREEMPTED", rank, e.step)
+else:
+    loss = fleet_resume_fit(
+        lambda: trainer.fit(data(), n_epochs=n_epochs, resume=True),
+        mesh=trainer.mesh, checkpoint=ck)
+    dump("resume")
+    print("FLEET_WORKER_OK", rank, loss)
+if ck is not None:
+    ck.ckpt.close()
