@@ -346,119 +346,167 @@ def bench_gpt():
             "causal_flash_routes": causal_flash}
 
 
-def bench_serving_decode(streams_ladder=(1, 4, 16),
-                         tick_batch_ladder=(1, 4, 8, 16),
-                         n_slots=16, t0=512, n_new=128):
-    """Continuous-batching serve window (GENERATION-style artifact):
-    the full tick-batch x concurrency grid — aggregate
-    new_tokens_per_sec, TTFT p50/p99, and host syncs per token at
-    1/4/16 concurrent streams for each fused-scan length K in
-    {1,4,8,16}, against the back-to-back single-caller ``generate()``
-    floor.  K=1 is the PR 2 host-driven server (one device->host poll
-    per token); larger K amortizes per-token dispatch overhead ~1/K
-    per token, at a bounded TTFT cost (the scheduler single-ticks
-    whenever admission is pending).  The ISSUE 5 acceptance bar:
-    K=8 at 16 streams strictly beats K=1 at 16 streams, with steady-
-    state host syncs per token <= 1/K."""
+def _streams_at_fixed_hbm(pool_rows, max_len, block_size, sys_len,
+                          totals):
+    """Admissibility math at a FIXED KV HBM budget (``pool_rows``
+    cached token rows): how many concurrent streams fit under (a) the
+    stripe layout — every stream pins a whole [max_len] stripe — and
+    (b) the paged layout — each stream pins ceil(total/bs) blocks with
+    the shared system prompt's full blocks resident ONCE.  ``totals``
+    is the mixed per-stream request length cycle (prompt + budget)."""
+    stripes = pool_rows // max_len
+    bs = block_size
+    n_pool = pool_rows // bs
+    # every FULL system-prompt block is shareable (the t0-1 hashing cap
+    # applies to a whole prompt's last token, not to a shared prefix
+    # that user tails always follow)
+    sys_blocks = sys_len // bs               # shared, counted once
+    used, blocks_streams = sys_blocks, 0
+    while True:
+        total = totals[blocks_streams % len(totals)]
+        need = -(-total // bs) - sys_blocks  # the stream's private tail
+        if used + need > n_pool:
+            break
+        used += need
+        blocks_streams += 1
+    return stripes, blocks_streams
+
+
+def bench_serving_decode(streams_ladder=(1, 4, 16), n_slots=16,
+                         sys_len=384, user_len=32, n_new=64,
+                         block_size=16, tick_batch=8, smoke=False):
+    """Paged-KV shared-prefix serve window -> SERVING_DECODE_r07.json:
+    1/4/16 concurrent streams sharing ONE long system prompt (unique
+    user tails), TTFT p50/p99 and aggregate tokens/s per rung, the
+    cold-prefill vs prefix-hit TTFT ratio (hit prefills only the
+    suffix — the shared-prefix win), and concurrent-streams-at-fixed-
+    HBM for stripes vs blocks at mixed request lengths (the paging
+    win: a short request pins blocks, not a [max_len] stripe, and the
+    system prompt is resident once).  Acceptance bar: prefix-hit TTFT
+    strictly below cold TTFT, and >= 2x concurrent streams at fixed
+    HBM.  ``smoke=True`` shrinks to a tiny CPU-runnable config (the
+    artifact CI records); the default geometry is the TPU run."""
     import threading
 
     import jax
-    from deeplearning4j_tpu import telemetry
-    from deeplearning4j_tpu.models.generation import TransformerGenerator
     from deeplearning4j_tpu.parallel import GenerationServer
     from deeplearning4j_tpu.zoo.gpt import Gpt
 
-    if jax.default_backend() not in ("tpu",):
-        raise RuntimeError("serving_decode bench requires a TPU backend")
-
-    m = Gpt(seq_len=t0, max_len=t0 + n_new)
+    if smoke:
+        streams_ladder = (1, 2, 4)
+        n_slots, sys_len, user_len, n_new, block_size = 4, 192, 8, 8, 8
+        m = Gpt(vocab_size=50, max_len=256, d_model=32, n_layers=2,
+                n_heads=4, d_ff=64, seq_len=8, compute_dtype=None,
+                seed=3)
+        compute_dtype = None
+    else:
+        if jax.default_backend() not in ("tpu",):
+            raise RuntimeError(
+                "serving_decode bench requires a TPU backend "
+                "(smoke=True for the CPU config)")
+        m = Gpt(seq_len=sys_len + user_len,
+                max_len=sys_len + user_len + n_new)
+        compute_dtype = "bfloat16"
     net = m.init_graph()
+    max_len = sys_len + user_len + n_new
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(0, m.vocab_size, t0).astype(np.int32)
-               for _ in range(2 * max(streams_ladder))]
-    syncs = telemetry.counter("generation_server_host_syncs_total")
+    vocab = m.vocab_size
 
-    # single-caller baseline: b=1 offline calls back to back
-    gen = TransformerGenerator(net, compute_dtype="bfloat16")
-    gen.generate(prompts[0][None], n_new=n_new)          # compile
-    t_base = time.perf_counter()
-    for p in prompts[1:4]:
-        gen.generate(p[None], n_new=n_new)
-    base_tok_s = 3 * n_new / (time.perf_counter() - t_base)
+    def prompt(prefix):
+        """The prefix + a fresh random user tail (each call draws a
+        NEW tail off the shared rng)."""
+        tail = rng.integers(0, vocab, user_len).astype(np.int32)
+        return np.concatenate([prefix, tail])
 
-    grid = []
-    for tb in tick_batch_ladder:
-        with GenerationServer(net, n_slots=n_slots, max_len=t0 + n_new,
-                              compute_dtype="bfloat16",
-                              tick_batch=tb) as srv:
-            # compile paths: prefill bucket + the full-K scan + the
-            # power-of-two drain chain (K/2 ... 1)
-            srv.submit(prompts[0], n_new=2 * tb)
-            srv.submit(prompts[0], n_new=max(tb - 1, 1))
-            for streams in streams_ladder:
-                reqs = prompts[:2 * streams]
-                handles = [None] * len(reqs)
-                errs = []
+    with GenerationServer(net, n_slots=n_slots, max_len=max_len,
+                          compute_dtype=compute_dtype,
+                          tick_batch=tick_batch,
+                          block_size=block_size) as srv:
+        # compile both admission paths + the scan chain on a THROWAWAY
+        # prefix so the measured colds stay genuinely cold
+        warm = rng.integers(0, vocab, sys_len).astype(np.int32)
+        srv.submit(prompt(warm), n_new=n_new)            # miss path
+        srv.submit(prompt(warm), n_new=n_new)            # hit path
+        srv.submit(prompt(warm), n_new=max(n_new - 1, 1))
 
-                def caller(lo):
-                    try:
-                        for i in range(lo, len(reqs), streams):
-                            handles[i] = srv.submit_async(reqs[i],
-                                                          n_new=n_new)
-                            handles[i].result()
-                    except Exception as e:  # threads swallow otherwise
-                        errs.append(e)
+        # cold vs prefix-hit TTFT, median of 3 fresh prefixes each
+        colds, hits = [], []
+        for t in range(3):
+            sysp = rng.integers(0, vocab, sys_len).astype(np.int32)
+            h = srv.submit_async(prompt(sysp), n_new=n_new)
+            h.result()
+            colds.append(h.ttft)
+            h = srv.submit_async(prompt(sysp), n_new=n_new)
+            h.result()
+            hits.append(h.ttft)
+        ttft_cold = float(np.median(colds))
+        ttft_hit = float(np.median(hits))
 
-                s0 = syncs.value
-                t_w = time.perf_counter()
-                threads = [threading.Thread(target=caller, args=(s,))
-                           for s in range(streams)]
-                for t in threads:
-                    t.start()
-                for t in threads:
-                    t.join()
-                if errs:
-                    raise errs[0]
-                dt = time.perf_counter() - t_w
-                n_tok = len(reqs) * n_new
-                ttfts = sorted(h.ttft for h in handles)
-                grid.append({
-                    "tick_batch": tb,
-                    "streams": streams,
-                    "requests": len(reqs),
-                    "new_tokens_per_sec": round(n_tok / dt, 1),
-                    "ttft_p50_s": round(
-                        float(np.percentile(ttfts, 50)), 4),
-                    "ttft_p99_s": round(
-                        float(np.percentile(ttfts, 99)), 4),
-                    "host_syncs_per_token": round(
-                        (syncs.value - s0) / n_tok, 4),
-                })
+        # the ladder: streams concurrent callers, one shared prefix
+        sysp = rng.integers(0, vocab, sys_len).astype(np.int32)
+        srv.submit(prompt(sysp), n_new=2)                # seed cache
+        ladder = []
+        for streams in streams_ladder:
+            reqs = [prompt(sysp) for _ in range(2 * streams)]
+            handles = [None] * len(reqs)
+            errs = []
 
-    def _at(tb, streams):
-        return next(r for r in grid if r["tick_batch"] == tb
-                    and r["streams"] == streams)
+            def caller(lo):
+                try:
+                    for i in range(lo, len(reqs), streams):
+                        handles[i] = srv.submit_async(reqs[i],
+                                                      n_new=n_new)
+                        handles[i].result()
+                except Exception as e:   # threads swallow otherwise
+                    errs.append(e)
 
-    top = max(streams_ladder)
-    k_hi = 8 if 8 in tick_batch_ladder else max(tick_batch_ladder)
-    k_lo = 1 if 1 in tick_batch_ladder else min(tick_batch_ladder)
-    agg_k8 = _at(k_hi, top)["new_tokens_per_sec"]
-    agg_k1 = _at(k_lo, top)["new_tokens_per_sec"]
-    return {"metric": "serving_decode_multi_tick_scan",
-            "value": agg_k8, "unit": "new_tokens/sec",
-            "model": "zoo.Gpt GPT-2-small-shaped",
-            "n_slots": n_slots, "prompt_len": t0, "n_new": n_new,
-            "single_caller_tokens_per_sec": round(base_tok_s, 1),
-            "k1_tokens_per_sec": agg_k1,
-            "k8_vs_k1": round(agg_k8 / agg_k1, 3),
-            "vs_baseline": round(agg_k8 / base_tok_s, 3),
-            "ladder": grid,
-            "note": "value is aggregate server tokens/s at K=8, "
-                    f"{top} streams; k8_vs_k1 is the fused-scan win "
-                    "over the per-token host-driven path (acceptance "
-                    "bar > 1x with host_syncs_per_token <= 1/K); "
-                    "vs_baseline is over back-to-back offline "
-                    "generate()"}
+            t_w = time.perf_counter()
+            threads = [threading.Thread(target=caller, args=(s,))
+                       for s in range(streams)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errs:
+                raise errs[0]
+            dt = time.perf_counter() - t_w
+            ttfts = sorted(h.ttft for h in handles)
+            ladder.append({
+                "streams": streams,
+                "requests": len(reqs),
+                "new_tokens_per_sec": round(len(reqs) * n_new / dt, 1),
+                "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 4),
+                "ttft_p99_s": round(float(np.percentile(ttfts, 99)), 4),
+            })
+
+    # fixed-HBM admissibility: the stripe pool's rows, mixed lengths —
+    # half full-budget requests, half short chat turns over the same
+    # system prompt
+    pool_rows = n_slots * max_len
+    totals = [max_len, sys_len + user_len + max(n_new // 4, 1)]
+    stripes, blocks = _streams_at_fixed_hbm(pool_rows, max_len,
+                                            block_size, sys_len, totals)
+    return {"metric": "serving_decode_paged_prefix",
+            "value": blocks, "unit": "concurrent_streams_at_fixed_hbm",
+            "model": ("tiny CPU-smoke Gpt" if smoke
+                      else "zoo.Gpt GPT-2-small-shaped"),
+            "smoke": smoke, "n_slots": n_slots,
+            "block_size": block_size, "kv_pool_rows": pool_rows,
+            "sys_len": sys_len, "user_len": user_len, "n_new": n_new,
+            "ttft_cold_s": round(ttft_cold, 4),
+            "ttft_prefix_hit_s": round(ttft_hit, 4),
+            "prefix_hit_ttft_ratio": round(ttft_hit / ttft_cold, 4),
+            "streams_stripes": stripes,
+            "streams_blocks": blocks,
+            "vs_baseline": round(blocks / max(stripes, 1), 3),
+            "mixed_request_totals": totals,
+            "ladder": ladder,
+            "note": "value is max admissible concurrent streams at "
+                    "the stripe pool's HBM footprint under the paged "
+                    "layout (mixed lengths, shared system prompt "
+                    "resident once); vs_baseline is the x-over the "
+                    "stripe layout's count; acceptance needs "
+                    "prefix_hit_ttft_ratio < 1 and vs_baseline >= 2"}
 
 
 def bench_mnist_mlp():

@@ -6,6 +6,11 @@ each kernel here is a few dozen lines of Python lowered through Mosaic.
 from deeplearning4j_tpu.kernels.flash_attention import (
     attention, flash_attention, mask_to_bias, reset_route_log, route_log,
     xla_attention)
+from deeplearning4j_tpu.kernels.paged_attention import (
+    paged_decode_attention, paged_decode_attention_reference,
+    paged_gather)
 
 __all__ = ["attention", "flash_attention", "mask_to_bias",
-           "reset_route_log", "route_log", "xla_attention"]
+           "paged_decode_attention", "paged_decode_attention_reference",
+           "paged_gather", "reset_route_log", "route_log",
+           "xla_attention"]

@@ -124,6 +124,44 @@ def _block_decode_step(ly: TransformerEncoderBlock, params, kcache,
     return y, kcache, vcache
 
 
+def _block_decode_step_paged(ly: TransformerEncoderBlock, params,
+                             kpool, vpool, x, pos, table, wblk, woff):
+    """Paged-cache variant of ``_block_decode_step``: the slot's K/V
+    live in pool blocks routed by a block table instead of a
+    contiguous stripe.  x: [b, d] new-token hidden; ``kpool``/``vpool``
+    [n_blocks, h, block_size, dh]; ``table`` [b, max_blocks] int32;
+    the new K/V row lands at (``wblk``, ``woff``) per slot — the
+    caller masks inactive slots to the scratch block 0 — and attention
+    reads THROUGH the table (``kernels.paged_decode_attention``; the
+    reference path mirrors the stripe step's f32-score/-1e9-mask math
+    exactly, which is what byte parity with offline decode rests on).
+    Returns (y [b, d], kpool, vpool)."""
+    from deeplearning4j_tpu.kernels import paged_decode_attention
+    b, d = x.shape
+    h, dh = ly.n_heads, d // ly.n_heads
+    cast = lambda w: w.astype(x.dtype)
+
+    qkv = x @ cast(params["Wqkv"]) + cast(params["bqkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split = lambda z: z.reshape(b, h, dh)
+    q, k, v = split(q), split(k), split(v)
+    kpool = kpool.at[wblk, :, woff, :].set(k)
+    vpool = vpool.at[wblk, :, woff, :].set(v)
+
+    att = paged_decode_attention(q, kpool, vpool, table, pos,
+                                 scale=1.0 / (dh ** 0.5))
+    att = att.reshape(b, d)
+    att = att @ cast(params["Wo"]) + cast(params["bo"])
+    hdn = _layer_norm(x + att, params["ln1_g"], params["ln1_b"], ly.eps)
+
+    from deeplearning4j_tpu.nn.activations import get_activation
+    act = get_activation(ly.activation or "gelu")
+    ffn = act(hdn @ cast(params["W1"]) + cast(params["b1"]))
+    ffn = ffn @ cast(params["W2"]) + cast(params["b2"])
+    y = _layer_norm(hdn + ffn, params["ln2_g"], params["ln2_b"], ly.eps)
+    return y, kpool, vpool
+
+
 def _embed_prompt(ly: EmbeddingSequenceLayer, params, ids):
     """[b, t0] int prompt -> [b, t0, d] (positions 0..t0-1)."""
     y = jnp.take(params["W"], ids.astype(jnp.int32), axis=0)
@@ -155,6 +193,49 @@ def _block_prefill(ly: TransformerEncoderBlock, params, x):
     p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
     att = jnp.einsum("bhqk,bhkd->bhqd", p, v)
     att = att.transpose(0, 2, 1, 3).reshape(b, t, d)
+    att = att @ cast(params["Wo"]) + cast(params["bo"])
+    hdn = _layer_norm(x + att, params["ln1_g"], params["ln1_b"], ly.eps)
+    from deeplearning4j_tpu.nn.activations import get_activation
+    act = get_activation(ly.activation or "gelu")
+    ffn = act(hdn @ cast(params["W1"]) + cast(params["b1"]))
+    ffn = ffn @ cast(params["W2"]) + cast(params["b2"])
+    y = _layer_norm(hdn + ffn, params["ln2_g"], params["ln2_b"], ly.eps)
+    return y, k, v
+
+
+def _block_prefill_chunked(ly: TransformerEncoderBlock, params, x,
+                           pk, pv, p0):
+    """Chunked (suffix) causal forward for one block: the query rows
+    are the UNCACHED prompt suffix at global positions p0..p0+s-1 and
+    the key set is [cached prefix K/V ; suffix K/V].  x: [b, s, d];
+    ``pk``/``pv``: [b, h, P, dh] gathered prefix rows (valid cols
+    < ``p0`` — the pad tail up to P is masked).  Same f32-score /
+    -1e9-mask / f32-softmax math as ``_block_prefill``; masked columns
+    contribute EXACT zeros to the softmax, so the suffix rows come out
+    byte-identical to the full-prompt prefill's — the prefix-cache hit
+    path's parity contract.  Returns (y, k, v) with k/v the SUFFIX
+    rows only."""
+    b, s_len, d = x.shape
+    h, dh = ly.n_heads, d // ly.n_heads
+    cast = lambda w: w.astype(x.dtype)
+    qkv = x @ cast(params["Wqkv"]) + cast(params["bqkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split = lambda z: z.reshape(b, s_len, h, dh).transpose(0, 2, 1, 3)
+    q, k, v = split(q), split(k), split(v)
+    P = pk.shape[2]
+    kk = jnp.concatenate([pk, k], axis=2)       # [b, h, P+s, dh]
+    vv = jnp.concatenate([pv, v], axis=2)
+    scale = 1.0 / (dh ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kk).astype(jnp.float32) * scale
+    cols = jnp.arange(P + s_len)
+    col_g = jnp.where(cols < P, cols, p0 + cols - P)   # global key pos
+    col_ok = jnp.where(cols < P, cols < p0, True)      # prefix pad out
+    rows_g = p0 + jnp.arange(s_len)
+    mask = col_ok[None, :] & (col_g[None, :] <= rows_g[:, None])
+    s = jnp.where(mask[None, None], s, -1e9)
+    p = jax.nn.softmax(s, axis=-1).astype(vv.dtype)
+    att = jnp.einsum("bhqk,bhkd->bhqd", p, vv)
+    att = att.transpose(0, 2, 1, 3).reshape(b, s_len, d)
     att = att @ cast(params["Wo"]) + cast(params["bo"])
     hdn = _layer_norm(x + att, params["ln1_g"], params["ln1_b"], ly.eps)
     from deeplearning4j_tpu.nn.activations import get_activation
@@ -291,6 +372,62 @@ class TransformerGenerator:
         x, (kc, vc) = jax.lax.scan(body, x, (blk_stack, kc, vc))
         logits = (x.astype(jnp.float32) @ head_p["W"] + head_p["b"])
         return logits, kc, vc
+
+    def _step_paged(self, emb_p, blk_stack, head_p, kc, vc, tok, pos,
+                    table, wblk, woff):
+        """Paged-pool decode tick: ``kc``/``vc`` are the global block
+        pools [n_layers, n_blocks, h, block_size, dh], ``table``
+        [b, max_blocks] the per-slot block tables, and the new row
+        lands at (``wblk``, ``woff``) per slot.  Same layer-scan
+        structure as ``_step``; attention routes through
+        ``kernels.paged_decode_attention``."""
+        x = _embed_token(self.emb, emb_p, tok, pos)
+        x = x.astype(self.compute_dtype)
+        ly = self.blocks[0]          # conf-identical (checked in init)
+
+        def body(h, layer):
+            p, kc_l, vc_l = layer
+            h, kc_l, vc_l = _block_decode_step_paged(
+                ly, p, kc_l, vc_l, h, pos, table, wblk, woff)
+            return h, (kc_l, vc_l)
+
+        x, (kc, vc) = jax.lax.scan(body, x, (blk_stack, kc, vc))
+        logits = (x.astype(jnp.float32) @ head_p["W"] + head_p["b"])
+        return logits, kc, vc
+
+    def _prefill_rows_chunked(self, emb_p, blk_stack, head_p, suffix,
+                              pk, pv, p0, last_ix):
+        """Chunked-prefill counterpart of ``_prefill_rows`` for
+        prefix-cache HITS: ``suffix`` [b, s] are the uncached prompt
+        tokens at global positions p0..p0+s-1 (pad tail beyond the
+        real suffix), ``pk``/``pv`` [n_layers, b, h, P, dh] the cached
+        prefix K/V gathered out of the block pool (valid cols < p0).
+        Returns (logits [b, V] at local row ``last_ix`` = t0-p0-1, ks,
+        vs [n_layers, b, h, s, dh]) — the SUFFIX rows only, for the
+        caller to scatter into fresh blocks.  Prefill runs only on the
+        suffix: the prefix's compute is the work the cache saves."""
+        cd = self.compute_dtype
+        ly = self.blocks[0]
+        pos = p0 + jnp.arange(suffix.shape[1])
+        y = jnp.take(emb_p["W"], suffix.astype(jnp.int32), axis=0)
+        if self.emb.add_positional:
+            # same rows _embed_prompt's [:t] slice reads; take clamps
+            # the pad tail (finite garbage, masked before any read)
+            y = y + jnp.take(emb_p["P"], pos, axis=0)
+        if self.emb.layer_norm:
+            y = _layer_norm(y, emb_p["g"], emb_p["b"], self.emb.eps)
+        x = y.astype(cd)
+
+        def body(hdn, layer):
+            p, pk_l, pv_l = layer
+            hdn, k, v = _block_prefill_chunked(ly, p, hdn, pk_l, pv_l,
+                                               p0)
+            return hdn, (k.astype(cd), v.astype(cd))
+
+        x, (ks, vs) = jax.lax.scan(body, x, (blk_stack, pk, pv))
+        last = jax.lax.dynamic_slice_in_dim(x, last_ix, 1, axis=1)[:, 0]
+        logits = last.astype(jnp.float32) @ head_p["W"] + head_p["b"]
+        return logits, ks, vs
 
     def generate(self, prompt_ids, n_new: int, temperature: float = 0.0,
                  seed: int = 0, max_len: Optional[int] = None,

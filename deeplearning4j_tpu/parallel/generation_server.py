@@ -7,17 +7,42 @@ set from HBM regardless of how many rows ride along
 at a fixed batch of 8), so aggregate tokens/s scales almost free with
 batch until memory binds.  This module multiplexes many concurrent
 ``submit()`` callers onto ONE jitted decode tick over a fixed pool of
-``n_slots`` slots sharing preallocated [n_layers, B, h, L, dh] KV
-caches — Orca-style continuous batching: requests join and leave
-mid-flight instead of waiting for the whole batch.
+``n_slots`` slots — Orca-style continuous batching: requests join and
+leave mid-flight instead of waiting for the whole batch.
+
+KV memory is PAGED (PR 7): instead of each slot owning a contiguous
+``[max_len]`` stripe (which pinned a whole stripe per request however
+short, and re-prefilled identical system prompts per request), K/V
+live in a global pool of ``kv_blocks`` fixed-size blocks
+([n_layers, 1 + kv_blocks, h, block_size, dh]; block 0 is the
+never-read scratch sink for masked-inactive writes) and every slot
+carries a device-resident ``[max_blocks]`` int32 **block table**
+beside its pos/remaining/EOS state.  A request pins
+``ceil((t0 + n_new) / block_size)`` blocks, so BLOCKS — not slots —
+are the scarce resource admission queues on.  Attention reads through
+the table via ``kernels.paged_attention`` (Pallas kernel on TPU, a
+``jnp.take``-gather reference path elsewhere — the reference mirrors
+the stripe math exactly, which is what keeps greedy byte parity with
+offline ``generate()`` through the paged rewrite).
+
+Shared-prefix reuse rides on the block pool: admission chain-hashes
+the prompt's full blocks, looks them up in a host-side ref-counted
+prefix cache (under ``_lock``), maps hits into the new slot's block
+table COPY-FREE, and prefill runs only on the uncached suffix
+(``_prefill_rows_chunked`` — the cached prefix's compute is the work
+the cache saves, the dominant serving win when many requests share
+one system prompt).  At retire a block whose refcount drains stays
+resident as an EVICTABLE cache entry (LRU-evicted only when admission
+runs short of free blocks), so the next same-prefix request still
+hits.
 
 Design:
 
 * the decode tick is ONE static-shape XLA program: per-slot
-  position / remaining-budget / EOS-id / sampling params live in
-  device-side state, sampling masks inactive slots, and cache writes
-  land at per-slot positions (``_block_decode_step``'s vector-``pos``
-  path);
+  position / remaining-budget / EOS-id / block-table / sampling params
+  live in device-side state, sampling masks inactive slots, and cache
+  writes land at (block, offset) targets routed through each slot's
+  table (``_block_decode_step_paged``);
 * the scheduler fuses up to ``tick_batch`` ticks into ONE device-side
   ``lax.scan`` (``_decode_scan``): sampled tokens stage in a [B, K]
   device buffer and the host polls ONCE per scan instead of once per
@@ -28,12 +53,17 @@ Design:
   drain exactly; retired/EOS slots inside a scan tick masked at pos 0,
   preserving the poisoned-slot invariant below);
 * between ticks the host scheduler admits queued requests into free
-  slots — prefill runs the existing batched causal forward
-  (``_block_prefill`` scanned over the stacked block params) with the
-  prompt padded to a power-of-two bucket (bounds prefill recompiles at
-  log2(L) variants; padded rows are never attended before being
-  overwritten by decode writes), and the resulting K/V rows are
-  scattered into the slot's cache;
+  slots — ON A MISS prefill runs the existing batched causal forward
+  (``_prefill_rows`` scanned over the stacked block params) with the
+  prompt padded to a power-of-two bucket rounded to the block size
+  (bounds prefill recompiles at log2(L) variants; padded rows are
+  never attended before being overwritten by decode writes); ON A
+  PREFIX HIT the cached blocks are gathered as the key prefix and
+  only the suffix prefills (``_prefill_rows_chunked``; the prefix
+  gather is EXACT-length — padding inside the key axis would change
+  XLA's reduction grouping and break byte parity, so hit-path
+  compiles key on (suffix bucket, matched blocks)).  Either way the
+  resulting K/V rows scatter into the slot's fresh blocks;
 * finished slots (budget exhausted or EOS sampled) retire back to
   their callers and free up for the next queued request.
 
@@ -44,15 +74,19 @@ thread can declare a tick stuck (``tick_timeout_s`` exceeded) or the
 scheduler dead, bump the epoch (the old thread, if it ever wakes, sees
 the stale token and exits without touching anything), and start a
 fresh scheduler — admission resumes instead of the server dying with
-its callers blocked forever.  Recovery is SURGICAL (KV salvage): the
-rows + per-slot device state of slots NOT implicated in the failure
-are snapshotted under the epoch-checked lock and scattered back into
-the rebuilt pool, so unaffected in-flight requests complete without
-resubmission, byte-identical to offline ``generate()`` — only the
-implicated slot(s) (a raising admission's slot, non-finite state, or
-an unrecoverable donated pool) fail with a typed
+its callers blocked forever.  Recovery is SURGICAL and
+BLOCK-GRANULAR (KV salvage): the finiteness screen runs per pool
+BLOCK, a slot is implicated only when one of ITS OWN blocks (or its
+held logits) is poisoned, and the rebuild zeroes exactly the dropped
+blocks — kept slots' blocks, their device state, AND finite
+prefix-cache blocks carry over, so unaffected in-flight requests
+complete without resubmission, byte-identical to offline
+``generate()``, and the prefix cache stays warm across a recovery —
+only the implicated slot(s) (a raising admission's slot, a poisoned
+block, or an unrecoverable donated pool) fail with a typed
 ``RetryableServerError``; queued requests just wait the recovery out
-(``kv_slots_salvaged_total`` / ``kv_slots_dropped_total``).
+(``kv_slots_{salvaged,dropped}_total`` and the block-granular
+``kv_blocks_{salvaged,dropped}_total``).
 Requests carry optional deadlines (queue wait counts), handles can be
 ``cancel()``-ed to release their queue entry/slot budget, blocking
 ``submit()`` optionally retries retryable failures with jittered
@@ -76,9 +110,9 @@ Cancelled / deadline-expired active slots are killed device-side (a
 tiny jitted ``remaining``-zeroing op) so they stop burning ticks
 instead of decoding out their budget as zombies.
 
-Not here yet (ROADMAP open items): paged / non-contiguous KV blocks
-(each slot owns a contiguous [L] stripe, so max_len bounds every
-request), speculative decode, and a TP/mesh-sharded tick.
+Not here yet (ROADMAP open items): speculative decode (the [B, K]
+staging buffer + per-slot ``emitted`` counters are the accept/reject
+machinery it will reuse) and a TP/mesh-sharded tick.
 """
 from __future__ import annotations
 
@@ -87,6 +121,7 @@ import logging
 import queue
 import threading
 import time
+from collections import OrderedDict, namedtuple
 from typing import Optional
 
 import jax
@@ -180,6 +215,46 @@ _KV_DROPPED = telemetry.counter(
     "kv_slots_dropped_total",
     "in-flight slots failed by a pool recovery (implicated in the "
     "failure, non-finite state, or unrecoverable donated buffers)")
+# Paged-pool series: the block economy.  allocated/freed track the
+# allocator's churn (freed counts refcount-drains — a drained block
+# may stay resident as an evictable prefix-cache entry), shared counts
+# copy-free prefix-block mappings (each one is a block of prefill
+# compute AND a block of HBM the cache saved), and the free gauge is
+# the admission headroom (free list + evictable cache entries).
+_KV_BLK_ALLOC = telemetry.counter(
+    "kv_blocks_allocated_total",
+    "fresh KV blocks claimed from the pool at admission")
+_KV_BLK_FREED = telemetry.counter(
+    "kv_blocks_freed_total",
+    "KV blocks whose refcount drained at retire/cancel/recovery "
+    "(cached blocks stay resident as evictable entries)")
+_KV_BLK_SHARED = telemetry.counter(
+    "kv_blocks_shared_total",
+    "prefix-cache blocks mapped copy-free into an admitted slot's "
+    "block table (prefill skipped for these tokens)")
+_POOL_FREE = telemetry.gauge(
+    "kv_pool_blocks_free",
+    "allocatable KV blocks (free list + evictable refcount-0 cache "
+    "entries) — admission queues when a request needs more")
+_PREFIX_HITS = telemetry.counter(
+    "prefix_cache_hits_total",
+    "admissions that mapped >= 1 cached prefix block (prefill ran "
+    "only on the uncached suffix)")
+_PREFIX_MISSES = telemetry.counter(
+    "prefix_cache_misses_total",
+    "admissions with no cached prefix block (full-prompt prefill)")
+# Block-granular salvage series (the slot-granular pair above stays
+# for request-level accounting): salvaged = blocks carried over a pool
+# recovery (kept slots' + finite cached), dropped = previously-used
+# blocks zeroed by the rebuild.
+_KV_BLK_SALVAGED = telemetry.counter(
+    "kv_blocks_salvaged_total",
+    "KV blocks carried over a pool recovery (kept slots' blocks + "
+    "finite prefix-cache blocks)")
+_KV_BLK_DROPPED = telemetry.counter(
+    "kv_blocks_dropped_total",
+    "previously-used KV blocks zeroed by a pool recovery (implicated "
+    "slots' private blocks + poisoned cache entries)")
 
 
 def _pow2_floor(n: int) -> int:
@@ -191,6 +266,16 @@ def _pow2_floor(n: int) -> int:
     while b * 2 <= n:
         b *= 2
     return b
+
+
+# One admission's block plan (host-side, built under _lock):
+# ``phys`` — the slot's physical block ids in table order (cached
+# prefix hits first, then fresh); ``matched`` — how many leading
+# entries are copy-free prefix-cache hits; ``hashes`` — the prompt's
+# full-block chain hashes (for registering the new blocks after the
+# prefill COMMITS); ``n_fresh`` — blocks claimed off the free list.
+_AdmitPlan = namedtuple("_AdmitPlan", ("phys", "matched", "hashes",
+                                       "n_fresh"))
 
 
 def _kill_slots(state, mask):
@@ -284,6 +369,17 @@ class GenerationServer:
     single ticks whenever a request is waiting for admission, so a
     join waits at most one in-flight scan.
 
+    KV memory is a PAGED pool: ``block_size`` tokens per block,
+    ``kv_blocks`` blocks total (default ``n_slots * ceil(max_len /
+    block_size)`` — the same HBM the old per-slot stripes held,
+    repackaged; shrink it to trade capacity for per-chip concurrency),
+    per-slot block tables device-resident.  A request pins
+    ``ceil((t0 + n_new) / block_size)`` blocks, so admission queues on
+    BLOCK availability, not slots.  ``prefix_cache=True`` (default)
+    shares identical prompt-prefix blocks across requests copy-free
+    and prefills only the uncached suffix; retired prefix blocks stay
+    resident (LRU-evicted on demand).
+
     Resilience knobs: ``tick_timeout_s`` arms the watchdog (None
     disables it; the stuck-tick deadline scales by the in-flight scan
     length — a K-tick scan legitimately runs ~K x longer);
@@ -299,6 +395,9 @@ class GenerationServer:
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
                  tick_batch: int = 8,
+                 block_size: int = 16,
+                 kv_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
                  queue_limit: int = 1024,
                  tick_timeout_s: Optional[float] = 30.0,
                  request_deadline_s: Optional[float] = None,
@@ -314,6 +413,22 @@ class GenerationServer:
             raise ValueError(
                 f"max_len {self.max_len} exceeds the model's positional "
                 f"table ({gen.emb.max_len} rows)")
+        self.block_size = int(block_size)
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        # table width: every slot can address a max-length request
+        self.max_blocks = -(-self.max_len // self.block_size)
+        # capacity-neutral default: the same HBM the old per-slot
+        # stripes occupied, repackaged as shareable blocks (shrink it
+        # to trade capacity for concurrency headroom per chip)
+        self.kv_blocks = (int(kv_blocks) if kv_blocks is not None
+                          else self.n_slots * self.max_blocks)
+        if self.kv_blocks < self.max_blocks:
+            raise ValueError(
+                f"kv_blocks={self.kv_blocks} cannot hold one "
+                f"max-length request ({self.max_blocks} blocks of "
+                f"{self.block_size} tokens)")
+        self.prefix_cache = bool(prefix_cache)
         if (top_k is not None or top_p is not None) and temperature <= 0:
             raise ValueError("top_k/top_p need temperature > 0 "
                              "(greedy ignores the filtered tail)")
@@ -389,18 +504,21 @@ class GenerationServer:
             self._watchdog.start()
 
     def _fresh_pool(self):
-        """(Re)allocate the KV caches and per-slot device state — every
-        slot inactive.  Also the error-recovery reset: the tick/admit
-        programs DONATE these buffers, so after a failed dispatch the
-        old arrays may already be invalidated."""
+        """(Re)allocate the KV block pool and per-slot device state —
+        every slot inactive, every block free, the prefix cache empty.
+        Also the error-recovery reset: the tick/admit programs DONATE
+        these buffers, so after a failed dispatch the old arrays may
+        already be invalidated."""
         gen = self._gen
-        B, L = self.n_slots, self.max_len
+        B = self.n_slots
         h = gen.blocks[0].n_heads
         dh = gen.emb.n_out // h
         n_layers = len(gen.blocks)
         cd = gen.compute_dtype
-        kc = jnp.zeros((n_layers, B, h, L, dh), cd)
-        vc = jnp.zeros((n_layers, B, h, L, dh), cd)
+        nb = self.kv_blocks + 1      # + block 0, the never-read
+                                     # scratch sink for masked writes
+        kc = jnp.zeros((n_layers, nb, h, self.block_size, dh), cd)
+        vc = jnp.zeros((n_layers, nb, h, self.block_size, dh), cd)
         state = {
             "pos": jnp.zeros((B,), jnp.int32),        # next write index
             "remaining": jnp.zeros((B,), jnp.int32),  # tokens to emit
@@ -413,11 +531,26 @@ class GenerationServer:
             "temp": jnp.zeros((B,), jnp.float32),
             "tk": jnp.full((B,), self._vocab, jnp.int32),
             "tp": jnp.ones((B,), jnp.float32),
+            # per-slot block table: logical block j of the slot lives
+            # in pool block table[slot, j]; 0 = unallocated (scratch)
+            "table": jnp.zeros((B, self.max_blocks), jnp.int32),
         }
         # commit atomically: this also runs on the watchdog's recovery
-        # path while the (fenced) scheduler may still be snapshotting
+        # path while the (fenced) scheduler may still be snapshotting.
+        # The host allocator truth resets WITH the device pool — free
+        # list (block 0 reserved), refcounts, prefix-cache map and the
+        # LRU of cached refcount-0 blocks.
         with self._lock:
             self._kc, self._vc, self._state = kc, vc, state
+            self._blocks_free = list(range(self.kv_blocks, 0, -1))
+            self._block_ref = np.zeros((nb,), np.int64)
+            self._prefix_map = {}        # chain hash -> (pool block
+                                         #  id, block token bytes —
+                                         #  verified on every hit)
+            self._block_hash = {}        # pool block id -> chain hash
+            self._evictable = OrderedDict()   # cached ref-0 blocks, LRU
+            self._slot_blocks = {}       # slot -> [pool block ids]
+        _POOL_FREE.set(self.kv_blocks)
 
     # -- public API ----------------------------------------------------
     def refresh_params(self):
@@ -484,6 +617,104 @@ class GenerationServer:
         tk_eff = self._vocab if tk is None else tk
         tp_eff = 1.0 if tp is None else tp
         return temp, tk_eff, tp_eff, int(samp.get("seed", seed))
+
+    # -- block allocator + prefix cache (host truth, under _lock) ------
+    def _chain_hashes(self, prompt: np.ndarray):
+        """(chain hash, block token bytes) per FULL prompt block —
+        h_j folds h_{j-1}, so a hit at j certifies the whole prefix
+        through j; the raw bytes ride along because a lookup VERIFIES
+        them (``hash()`` is 64-bit and non-cryptographic — a collision
+        must degrade to a miss, never silently map another prompt's KV
+        into this request).  Capped at t0 - 1 tokens: a fully-cached
+        prompt must still prefill >= 1 suffix token, because logits
+        come from the suffix forward (K/V are cached; hidden states
+        are not)."""
+        bs = self.block_size
+        hashes, h = [], 0
+        for j in range((len(prompt) - 1) // bs):
+            tok = prompt[j * bs:(j + 1) * bs].tobytes()
+            h = hash((h, tok))
+            hashes.append((h, tok))
+        return hashes
+
+    def _plan_admission_locked(self, req: _Pending):
+        """Match cached prefix blocks and claim the rest off the free
+        list (evicting LRU cache entries as needed); returns an
+        ``_AdmitPlan``, or None when the pool cannot cover the request
+        right now — BLOCKS are the scarce resource, so the caller
+        leaves the request at the head of the wait line (a retiring
+        request frees blocks, not just a slot)."""
+        bs = self.block_size
+        total = -(-(req.t0 + req.n_new) // bs)
+        hashes = (self._chain_hashes(req.prompt)
+                  if self.prefix_cache else [])
+        matched_ids = []
+        for hsh, tok in hashes:
+            entry = self._prefix_map.get(hsh)
+            if entry is None or entry[1] != tok:
+                break                # miss — or a hash collision,
+            matched_ids.append(entry[0])   # which must NOT map in
+        need = total - len(matched_ids)
+        # matched hits sitting in the evictable LRU are about to be
+        # CLAIMED, not evicted — they don't count as reclaimable
+        ev_matched = sum(1 for blk in matched_ids
+                         if self._block_ref[blk] == 0
+                         and blk in self._evictable)
+        if need > (len(self._blocks_free) + len(self._evictable)
+                   - ev_matched):
+            return None
+        # claim the hits FIRST: a hit sitting in the evictable LRU must
+        # leave it before the eviction loop below could reclaim it
+        for blk in matched_ids:
+            if self._block_ref[blk] == 0:
+                self._evictable.pop(blk, None)
+            self._block_ref[blk] += 1
+        while need > len(self._blocks_free):
+            blk, _ = self._evictable.popitem(last=False)    # LRU out
+            del self._prefix_map[self._block_hash.pop(blk)]
+            self._blocks_free.append(blk)
+        fresh = [self._blocks_free.pop() for _ in range(need)]
+        for blk in fresh:
+            self._block_ref[blk] = 1
+        return _AdmitPlan(matched_ids + fresh, len(matched_ids),
+                          hashes, len(fresh))
+
+    def _register_prefix_locked(self, plan: _AdmitPlan):
+        """After the prefill COMMITS, publish the request's new full
+        prompt blocks into the prefix cache (the matched prefix is
+        already there).  Full prompt blocks are never written after
+        prefill — decode writes land at pos >= t0, strictly past every
+        full block — so sharing them is safe by construction."""
+        for j in range(plan.matched, len(plan.hashes)):
+            hsh, tok = plan.hashes[j]
+            if hsh in self._prefix_map:
+                continue                 # coincident entry stands
+            blk = plan.phys[j]
+            self._prefix_map[hsh] = (blk, tok)
+            self._block_hash[blk] = hsh
+
+    def _release_slot_blocks_locked(self, slot: int) -> int:
+        """Decref a retiring slot's blocks; refcount-0 blocks return
+        to the free list, unless prefix-cached — those stay resident
+        as evictable LRU entries so the next same-prefix request still
+        hits.  Returns the number of refcount-drains (the
+        ``kv_blocks_freed_total`` increment, counted by the caller
+        outside the lock)."""
+        drained = 0
+        for blk in self._slot_blocks.pop(slot, ()):
+            self._block_ref[blk] -= 1
+            if self._block_ref[blk] > 0:
+                continue
+            drained += 1
+            if blk in self._block_hash:
+                self._evictable[blk] = None
+            else:
+                self._blocks_free.append(blk)
+        return drained
+
+    def _update_free_gauge(self):
+        with self._lock:
+            _POOL_FREE.set(len(self._blocks_free) + len(self._evictable))
 
     def submit_async(self, prompt_ids, n_new: int,
                      eos_id: Optional[int] = None,
@@ -653,15 +884,14 @@ class GenerationServer:
         """K static-shape decode ticks fused into ONE ``lax.scan``
         (cached per (K, sampled)): each tick samples every active
         slot's next token from its held logits, writes it at the
-        slot's position, advances every cache one step, decrements
-        budgets, zeroes the budget on EOS.  Inactive slots (free, or
-        retired MID-SCAN by EOS / budget drain) flow through with a
-        masked write at position 0, NOT their stale pos: a
-        just-finished max-length request parks pos == max_len, and an
-        out-of-bounds positional-table take fills NaN — which the
-        clamped cache write would smear into row L-1 and poison the
-        slot's next request.  Row 0 of a FREE slot is always rewritten
-        by admission prefill before any read.
+        slot's (block, offset) through its block table, advances every
+        cache one step, decrements budgets, zeroes the budget on EOS.
+        Inactive slots (free, or retired MID-SCAN by EOS / budget
+        drain) flow through with a masked write into the SCRATCH
+        block 0 (never referenced by a live table), NOT their stale
+        pos: a just-finished max-length request parks pos == max_len,
+        and an out-of-bounds positional-table take fills NaN — which
+        a clamped write would smear into a live block and poison it.
 
         Returns ``(kc, vc, state, tokens [B, K], emitted [B],
         n_alive)`` — tokens stage device-side and the host polls ONCE
@@ -676,6 +906,7 @@ class GenerationServer:
             return fn
         gen = self._gen
         pick = self._sampler(sampled)
+        bs = self.block_size
 
         def scan_fn(emb_p, blk_stack, head_p, kc, vc, state):
             def step(carry, _):
@@ -685,8 +916,17 @@ class GenerationServer:
                 tok, keys = pick(state)
                 tok = jnp.where(active, tok, 0).astype(jnp.int32)
                 pos = jnp.where(active, state["pos"], 0)
-                new_logits, kc, vc = gen._step(emb_p, blk_stack,
-                                               head_p, kc, vc, tok, pos)
+                # route the write through the slot's block table;
+                # inactive slots land in the scratch block 0 (never
+                # read) — the paged analogue of the masked pos-0 write
+                tbl = state["table"]
+                bidx = jnp.take_along_axis(
+                    tbl, (pos // bs)[:, None], axis=1)[:, 0]
+                wblk = jnp.where(active, bidx, 0)
+                woff = jnp.where(active, pos % bs, 0)
+                new_logits, kc, vc = gen._step_paged(
+                    emb_p, blk_stack, head_p, kc, vc, tok, pos, tbl,
+                    wblk, woff)
                 hit_eos = active & (tok == state["eos"])
                 remaining = jnp.where(active, state["remaining"] - 1, 0)
                 remaining = jnp.where(hit_eos, 0, remaining)
@@ -701,6 +941,7 @@ class GenerationServer:
                     "temp": state["temp"],
                     "tk": state["tk"],
                     "tp": state["tp"],
+                    "table": tbl,
                 }
                 emitted = emitted + active.astype(jnp.int32)
                 return (kc, vc, state, emitted), tok
@@ -720,49 +961,111 @@ class GenerationServer:
                                              donate_argnums=(3, 4, 5))
         return fn
 
-    def _admit_fn(self, tb: int):
-        """Admission program for prefill bucket ``tb`` (cached per
-        bucket): batched causal prefill of the padded prompt, K/V rows
-        scattered into the slot's cache stripe, slot state armed."""
-        if tb in self._admit_cache:
-            return self._admit_cache[tb]
+    def _scatter_rows(self, pool, rows, phys):
+        """Scatter prefill K/V rows into pool blocks: ``rows``
+        [n_layers, 1, h, T, dh] with T a block-size multiple, ``phys``
+        [T // block_size] int32 physical block ids (entries past the
+        slot's allocation point at the scratch block 0 — pad rows land
+        there harmlessly)."""
+        bs = self.block_size
+        nl, _, h, T, dh = rows.shape
+        blocks = rows[:, 0].reshape(nl, h, T // bs, bs, dh) \
+                           .transpose(0, 2, 1, 3, 4)
+        return pool.at[:, phys].set(blocks)
+
+    def _arm_slot(self, state, logits, slot, t0, n_new, eos_id, key,
+                  temp, tk, tp, table_row):
+        """Slot device-state update shared by both admit programs."""
+        return {
+            "pos": state["pos"].at[slot].set(t0),
+            "remaining": state["remaining"].at[slot].set(n_new),
+            "eos": state["eos"].at[slot].set(eos_id),
+            "logits": jax.lax.dynamic_update_slice(
+                state["logits"], logits, (slot, 0)),
+            "key": jax.lax.dynamic_update_slice(
+                state["key"], key[None], (slot, 0)),
+            "temp": state["temp"].at[slot].set(temp),
+            "tk": state["tk"].at[slot].set(tk),
+            "tp": state["tp"].at[slot].set(tp),
+            "table": jax.lax.dynamic_update_slice(
+                state["table"], table_row[None], (slot, 0)),
+        }
+
+    def _admit_miss_fn(self, tb: int):
+        """Prefix-MISS admission program for prefill bucket ``tb`` (a
+        block-size multiple; cached per bucket): batched causal
+        prefill of the padded prompt — the SAME prefill numerics
+        offline decode runs, parity depends on it — with the K/V rows
+        scattered into the slot's fresh blocks and its table armed."""
+        key = ("miss", tb)
+        if key in self._admit_cache:
+            return self._admit_cache[key]
         gen = self._gen
 
         def admit(emb_p, blk_stack, head_p, kc, vc, state, prompt, t0,
-                  slot, n_new, eos_id, key, temp, tk, tp):
-            # the SAME prefill program offline decode runs (parity
-            # depends on it); t0 picks the last REAL position's logits
-            # out of the padded bucket
+                  slot, n_new, eos_id, key, temp, tk, tp, phys,
+                  table_row):
+            # t0 picks the last REAL position's logits out of the
+            # padded bucket
             logits, ks, vs = gen._prefill_rows(emb_p, blk_stack,
                                                head_p, prompt, t0)
-            kc = jax.lax.dynamic_update_slice(kc, ks, (0, slot, 0, 0, 0))
-            vc = jax.lax.dynamic_update_slice(vc, vs, (0, slot, 0, 0, 0))
-            state = {
-                "pos": state["pos"].at[slot].set(t0),
-                "remaining": state["remaining"].at[slot].set(n_new),
-                "eos": state["eos"].at[slot].set(eos_id),
-                "logits": jax.lax.dynamic_update_slice(
-                    state["logits"], logits, (slot, 0)),
-                "key": jax.lax.dynamic_update_slice(
-                    state["key"], key[None], (slot, 0)),
-                "temp": state["temp"].at[slot].set(temp),
-                "tk": state["tk"].at[slot].set(tk),
-                "tp": state["tp"].at[slot].set(tp),
-            }
+            kc = self._scatter_rows(kc, ks, phys)
+            vc = self._scatter_rows(vc, vs, phys)
+            state = self._arm_slot(state, logits, slot, t0, n_new,
+                                   eos_id, key, temp, tk, tp, table_row)
             return kc, vc, state
 
-        fn = self._admit_cache[tb] = jax.jit(admit,
-                                             donate_argnums=(3, 4, 5))
+        fn = self._admit_cache[key] = jax.jit(admit,
+                                              donate_argnums=(3, 4, 5))
+        return fn
+
+    def _admit_hit_fn(self, sb: int, matched: int):
+        """Prefix-HIT admission program (cached per (suffix bucket,
+        matched blocks)): gather the ``matched`` cached blocks as the
+        key prefix, chunked-prefill ONLY the suffix, scatter the
+        suffix K/V into the slot's fresh blocks.  The prefix gather is
+        EXACT-length — padding inside the key axis would regroup XLA's
+        softmax/matmul reductions and break byte parity with the
+        full-prompt prefill, so ``matched`` is a compile-key dimension
+        (bounded by max_blocks) instead of a padded pow2."""
+        key = ("hit", sb, matched)
+        if key in self._admit_cache:
+            return self._admit_cache[key]
+        gen = self._gen
+
+        def admit(emb_p, blk_stack, head_p, kc, vc, state, suffix, p0,
+                  last_ix, t0, slot, n_new, eos_id, key, temp, tk, tp,
+                  prefix_phys, phys, table_row):
+            nl = kc.shape[0]
+            h, bs, dh = kc.shape[2], kc.shape[3], kc.shape[4]
+            gather = lambda pool: jnp.take(pool, prefix_phys, axis=1) \
+                .transpose(0, 2, 1, 3, 4) \
+                .reshape(nl, 1, h, matched * bs, dh)
+            pk, pv = gather(kc), gather(vc)
+            logits, ks, vs = gen._prefill_rows_chunked(
+                emb_p, blk_stack, head_p, suffix, pk, pv, p0, last_ix)
+            kc = self._scatter_rows(kc, ks, phys)
+            vc = self._scatter_rows(vc, vs, phys)
+            state = self._arm_slot(state, logits, slot, t0, n_new,
+                                   eos_id, key, temp, tk, tp, table_row)
+            return kc, vc, state
+
+        fn = self._admit_cache[key] = jax.jit(admit,
+                                              donate_argnums=(3, 4, 5))
         return fn
 
     # -- scheduler -----------------------------------------------------
-    def _admit(self, req: _Pending, slot: int, my_epoch: int) -> bool:
+    def _admit(self, req: _Pending, slot: int, plan: _AdmitPlan,
+               my_epoch: int) -> bool:
         """Prefill dispatch + commit; returns False when a watchdog
         recovery superseded this scheduler mid-admission (the caller
-        must exit without touching shared state)."""
-        tb = _bucket(req.t0, self.max_len)
-        padded = np.zeros((1, tb), np.int32)
-        padded[0, :req.t0] = req.prompt
+        must exit without touching shared state — the recovery already
+        reconciled the allocator off ``_slot_blocks``)."""
+        bs = self.block_size
+        matched = plan.matched
+        p0 = matched * bs
+        table_row = np.zeros((self.max_blocks,), np.int32)
+        table_row[:len(plan.phys)] = plan.phys
         emb_p, blk_stack, head_p = self._params
         # snapshot the pool atomically: a concurrent watchdog recovery
         # swaps all three together, and a torn read would scatter this
@@ -770,13 +1073,43 @@ class GenerationServer:
         with self._lock:
             kc, vc, state = self._kc, self._vc, self._state
         _sanitize.check_not_donated("serve/admit", kc, vc, state)
-        out = self._admit_fn(tb)(
-            emb_p, blk_stack, head_p, kc, vc, state,
-            jnp.asarray(padded), np.int32(req.t0), np.int32(slot),
-            np.int32(req.n_new), np.int32(req.eos_id),
-            jax.random.PRNGKey(req.seed),
-            np.float32(req.temperature), np.int32(req.top_k),
-            np.float32(req.top_p))
+        if matched:
+            # prefix HIT: gather the cached blocks, prefill only the
+            # suffix — scatter targets start at the first fresh block
+            suffix = req.prompt[p0:]
+            sb = -(-_bucket(len(suffix), self.max_len) // bs) * bs
+            padded = np.zeros((1, sb), np.int32)
+            padded[0, :len(suffix)] = suffix
+            n_sc = sb // bs
+            fresh = plan.phys[matched:matched + n_sc]
+            scatter_phys = np.zeros((n_sc,), np.int32)
+            scatter_phys[:len(fresh)] = fresh
+            out = self._admit_hit_fn(sb, matched)(
+                emb_p, blk_stack, head_p, kc, vc, state,
+                jnp.asarray(padded), np.int32(p0),
+                np.int32(req.t0 - p0 - 1), np.int32(req.t0),
+                np.int32(slot), np.int32(req.n_new),
+                np.int32(req.eos_id), jax.random.PRNGKey(req.seed),
+                np.float32(req.temperature), np.int32(req.top_k),
+                np.float32(req.top_p),
+                jnp.asarray(plan.phys[:matched], jnp.int32),
+                jnp.asarray(scatter_phys), jnp.asarray(table_row))
+        else:
+            tb = -(-_bucket(req.t0, self.max_len) // bs) * bs
+            padded = np.zeros((1, tb), np.int32)
+            padded[0, :req.t0] = req.prompt
+            n_sc = tb // bs
+            scatter_phys = np.zeros((n_sc,), np.int32)
+            head = plan.phys[:n_sc]
+            scatter_phys[:len(head)] = head
+            out = self._admit_miss_fn(tb)(
+                emb_p, blk_stack, head_p, kc, vc, state,
+                jnp.asarray(padded), np.int32(req.t0), np.int32(slot),
+                np.int32(req.n_new), np.int32(req.eos_id),
+                jax.random.PRNGKey(req.seed),
+                np.float32(req.temperature), np.int32(req.top_k),
+                np.float32(req.top_p), jnp.asarray(scatter_phys),
+                jnp.asarray(table_row))
         _sanitize.mark_donated("serve/admit", kc, vc, state)
         with self._lock:
             if self._epoch != my_epoch:
@@ -786,7 +1119,17 @@ class GenerationServer:
                                          # rows are THIS request's now
             # _ids row under the same lock: _retire copies from it
             self._ids[slot, :req.t0] = req.prompt
+            if self.prefix_cache:
+                self._register_prefix_locked(plan)
         _ADMITTED.inc()
+        if matched:
+            _PREFIX_HITS.inc()
+            _KV_BLK_SHARED.inc(matched)
+        else:
+            _PREFIX_MISSES.inc()
+        if plan.n_fresh:
+            _KV_BLK_ALLOC.inc(plan.n_fresh)
+        self._update_free_gauge()
         return True
 
     def _retire(self, req: _Pending, slot: int, error=None):
@@ -857,8 +1200,11 @@ class GenerationServer:
             self._staged.clear()
             self._pending = []
             self._free = list(range(self.n_slots - 1, -1, -1))
+            for slot in list(self._slot_blocks):
+                self._release_slot_blocks_locked(slot)
         for req in victims:
             self._retire(req, -1, error=err)
+        self._update_free_gauge()
         _SLOTS_BUSY.set(0)
         _QDEPTH.set(self._queue.qsize())
 
@@ -888,6 +1234,7 @@ class GenerationServer:
         epoch-checked lock (PR 4 discipline); returns False when a
         concurrent recovery superseded ``my_epoch``."""
         to_fail = []
+        n_blk_salvaged = n_blk_dropped = 0
         with self._lock:
             if self._epoch != my_epoch:
                 return False
@@ -898,16 +1245,17 @@ class GenerationServer:
                     for leaf in jax.tree_util.tree_leaves(
                         (kc, vc, state)))
                 if pool_alive:
-                    # trust-but-verify the salvage source: a slot whose
-                    # KV rows or held logits are non-finite (the PR 2
-                    # poisoned-slot class) must NOT be carried over —
-                    # it would keep emitting garbage forever.  One
-                    # device-side reduce + a [B] transfer, not a full
-                    # pool pull.
-                    finite = np.asarray(
-                        jnp.isfinite(state["logits"]).all(axis=1)
-                        & jnp.isfinite(kc).all(axis=(0, 2, 3, 4))
+                    # trust-but-verify the salvage source, at BLOCK
+                    # granularity: a non-finite pool block (the PR 2
+                    # poisoned class) implicates exactly the slots
+                    # whose tables reference it — not whole stripes.
+                    # One device-side reduce + [n_blocks]/[B]
+                    # transfers, not a full pool pull.
+                    blk_fin = np.asarray(
+                        jnp.isfinite(kc).all(axis=(0, 2, 3, 4))
                         & jnp.isfinite(vc).all(axis=(0, 2, 3, 4)))
+                    log_fin = np.asarray(
+                        jnp.isfinite(state["logits"]).all(axis=1))
                     pos_h = np.asarray(state["pos"])
                     rem_h = np.asarray(state["remaining"])
             except RuntimeError:
@@ -922,6 +1270,7 @@ class GenerationServer:
                     victims[slot] = "unrecoverable"
             else:
                 for slot, req in self._active.items():
+                    blocks = self._slot_blocks.get(slot, ())
                     if slot in implicated:
                         victims[slot] = "implicated"
                     elif slot in self._staged:
@@ -935,25 +1284,44 @@ class GenerationServer:
                         victims[slot] = "cancelled"
                     elif req.deadline is not None and now > req.deadline:
                         victims[slot] = "deadline"
-                    elif not bool(finite[slot]):
+                    elif not (bool(log_fin[slot]) and
+                              all(bool(blk_fin[b]) for b in blocks)):
                         victims[slot] = "poisoned"
                     elif pos_h[slot] == 0 and rem_h[slot] == 0:
                         # device-truth backstop for the same class on
                         # a never-used slot (prefill sets pos >= 1)
                         victims[slot] = "unadmitted"
             keep = sorted(s for s in self._active if s not in victims)
+            # block accounting BEFORE any release/rebuild mutates the
+            # allocator: dropped = used-before minus carried-over
+            used_before = set(self._block_hash)
+            for s in self._active:
+                used_before.update(self._slot_blocks.get(s, ()))
             if pool_alive and keep:
-                # snapshot-salvage the kept rows and scatter them into
-                # a rebuilt (zeroed) pool in one masked pass: the old
-                # arrays are read eagerly (no donation), so this IS the
-                # gather + fresh pool + scatter-back, fused — kept
-                # slots carry their exact KV bytes, positions, budgets
-                # and PRNG streams; every other row is the fresh-pool
-                # zero state
+                # block-granular salvage: keep exactly the kept slots'
+                # blocks plus finite prefix-cache blocks (the cache
+                # stays WARM across a recovery) and zero every other
+                # block in one masked pass — the old arrays are read
+                # eagerly (no donation), so this IS the gather + fresh
+                # pool + scatter-back, fused.  Kept slots carry their
+                # exact KV bytes, tables, positions, budgets and PRNG
+                # streams.
                 mask = np.zeros((self.n_slots,), bool)
                 mask[keep] = True
                 m = jnp.asarray(mask)
-                row = lambda nd: m.reshape((1, -1) + (1,) * (nd - 2))
+                # poisoned cache entries drop out of the map first
+                bad_cached = [b for b in self._block_hash
+                              if not bool(blk_fin[b])]
+                for b in bad_cached:
+                    del self._prefix_map[self._block_hash.pop(b)]
+                    self._evictable.pop(b, None)
+                    if self._block_ref[b] == 0:
+                        self._blocks_free.append(b)
+                bmask = np.zeros((self.kv_blocks + 1,), bool)
+                for s in keep:
+                    bmask[self._slot_blocks.get(s, ())] = True
+                for b in self._block_hash:
+                    bmask[b] = True
                 try:
                     # ledger-checked read (DL4J_TPU_SANITIZE=donation):
                     # the salvage source must not be a buffer some
@@ -965,8 +1333,10 @@ class GenerationServer:
                     # watchdog thread.
                     _sanitize.check_not_donated("serve/salvage", kc,
                                                 vc, state)
-                    self._kc = jnp.where(row(kc.ndim), kc, 0)
-                    self._vc = jnp.where(row(vc.ndim), vc, 0)
+                    bm = jnp.asarray(bmask)
+                    keep_blk = bm[None, :, None, None, None]
+                    self._kc = jnp.where(keep_blk, kc, 0)
+                    self._vc = jnp.where(keep_blk, vc, 0)
                     self._state = {
                         "pos": jnp.where(m, state["pos"], 0),
                         "remaining": jnp.where(m, state["remaining"],
@@ -978,7 +1348,12 @@ class GenerationServer:
                         "temp": jnp.where(m, state["temp"], 0.0),
                         "tk": jnp.where(m, state["tk"], self._vocab),
                         "tp": jnp.where(m, state["tp"], 1.0),
+                        "table": jnp.where(m[:, None], state["table"],
+                                           0),
                     }
+                    n_blk_salvaged = int(bmask.sum())
+                    n_blk_dropped = len(used_before
+                                        - set(np.nonzero(bmask)[0]))
                 except RuntimeError:
                     # consumed mid-rebuild: demote every kept slot to
                     # unrecoverable and fall back to the clean rebuild
@@ -986,14 +1361,20 @@ class GenerationServer:
                         victims[slot] = "unrecoverable"
                     keep = []
                     self._fresh_pool()
+                    n_blk_salvaged, n_blk_dropped = 0, len(used_before)
             else:
                 # nothing salvageable (or nothing active): clean
                 # rebuild — the donating dispatch may have consumed
-                # the old buffers.  RLock: _fresh_pool's own commit
-                # nests inside this epoch-checked section.
+                # the old buffers (allocator + prefix cache reset with
+                # it).  RLock: _fresh_pool's own commit nests inside
+                # this epoch-checked section.
                 self._fresh_pool()
+                n_blk_dropped = len(used_before)
             for slot, why in victims.items():
                 to_fail.append((self._active.pop(slot), why))
+                # reconcile the allocator (no-op after a fresh rebuild:
+                # _slot_blocks was reset wholesale)
+                self._release_slot_blocks_locked(slot)
             self._staged.clear()         # every staged slot just fell
                                          # into victims["unadmitted"]
             self._free = [s for s in range(self.n_slots - 1, -1, -1)
@@ -1004,9 +1385,16 @@ class GenerationServer:
             _KV_SALVAGED.inc(len(keep))
         if to_fail:
             _KV_DROPPED.inc(len(to_fail))
-        log.warning("pool recovery: salvaged %d in-flight slot(s) %s, "
-                    "dropped %d (%s)", len(keep), keep, len(to_fail),
-                    ", ".join(why for _, why in to_fail) or "none")
+        if n_blk_salvaged:
+            _KV_BLK_SALVAGED.inc(n_blk_salvaged)
+        if n_blk_dropped:
+            _KV_BLK_DROPPED.inc(n_blk_dropped)
+        self._update_free_gauge()
+        log.warning("pool recovery: salvaged %d in-flight slot(s) %s "
+                    "(%d block(s)), dropped %d (%s; %d block(s))",
+                    len(keep), keep, n_blk_salvaged, len(to_fail),
+                    ", ".join(why for _, why in to_fail) or "none",
+                    n_blk_dropped)
         for req, why in to_fail:
             if why == "cancelled":
                 _CANCELLED.inc()
@@ -1086,25 +1474,37 @@ class GenerationServer:
                     reaped = self._reap_pending_locked(now)
                     admits = []
                     while self._free and self._pending:
-                        req = self._pending.pop(0)
+                        req = self._pending[0]
+                        # BLOCKS are the scarce resource: when the pool
+                        # cannot cover the head request it waits at the
+                        # head of the line (FIFO — no starvation by
+                        # smaller requests behind it); a retiring
+                        # request frees blocks, not just its slot
+                        plan = self._plan_admission_locked(req)
+                        if plan is None:
+                            break
+                        self._pending.pop(0)
                         slot = self._free.pop()
                         # active BEFORE the prefill dispatch: if the
                         # watchdog takes over mid-admission the request
                         # must be in the set it fails over — staged
                         # until the prefill COMMITS, so the recovery
                         # fails it instead of salvaging the previous
-                        # occupant's device rows as its result
+                        # occupant's device rows as its result.  The
+                        # block claim registers here too, so a
+                        # recovery can reconcile the allocator.
                         self._active[slot] = req
                         self._staged.add(slot)
-                        admits.append((req, slot))
+                        self._slot_blocks[slot] = list(plan.phys)
+                        admits.append((req, slot, plan))
                     n_pending = len(self._pending)
                     n_active = len(self._active)
                 self._retire_reaped(reaped)
-                for req, slot in admits:
+                for req, slot, plan in admits:
                     self._mark_tick(my_epoch,
                                     (my_epoch, time.monotonic(), 1))
                     admitting = slot     # a raising prefill implicates
-                    committed = self._admit(req, slot, my_epoch)
+                    committed = self._admit(req, slot, plan, my_epoch)
                     admitting = None     # only ITS slot in recovery
                     self._mark_tick(my_epoch, None)
                     if not committed:
@@ -1187,6 +1587,7 @@ class GenerationServer:
                 now_p = time.perf_counter()
                 now_m = time.monotonic()
                 finished = []
+                n_drained = 0
                 with self._lock:
                     if self._epoch != my_epoch:
                         return
@@ -1212,11 +1613,19 @@ class GenerationServer:
                         if done or req.cancelled or expired:
                             del self._active[slot]
                             self._free.append(slot)
+                            # blocks back to the pool (cached prefix
+                            # blocks park in the evictable LRU)
+                            n_drained += \
+                                self._release_slot_blocks_locked(slot)
                             finished.append((req, slot, done))
                             if not done:
                                 kill.append(slot)
                     n_active = len(self._active)
                     n_pending = len(self._pending)
+                if n_drained:
+                    _KV_BLK_FREED.inc(n_drained)
+                if finished:
+                    self._update_free_gauge()
                 for req, slot, done in finished:
                     if done:
                         self._retire(req, slot)

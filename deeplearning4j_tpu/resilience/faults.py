@@ -255,29 +255,37 @@ def throttled_stall_plan(n_throttles: int, final: str,
 
 
 def poison_slot_kv(server, slot: int, timeout_s: float = 10.0) -> bool:
-    """NaN-poison one slot's KV row in a live ``GenerationServer`` —
+    """NaN-poison one slot's KV in a live ``GenerationServer`` —
     the deterministic stand-in for device memory corruption the
-    salvage path's finiteness screen must catch.  The tick dispatch
-    donates the pool (honored even on CPU), so a write can hit a
-    consumed buffer or be overwritten by an in-flight commit: retry
-    until the NaN verifiably sticks in the COMMITTED pool."""
+    salvage path's finiteness screen must catch.  The pool is PAGED
+    (PR 7): the poke targets one of the slot's own blocks through the
+    host block registry, preferring a PRIVATE (refcount 1) block so a
+    shared prefix block doesn't implicate innocent slots.  The tick
+    dispatch donates the pool (honored even on CPU), so a write can
+    hit a consumed buffer or be overwritten by an in-flight commit:
+    retry until the NaN verifiably sticks in the COMMITTED pool."""
     import jax.numpy as jnp
     import numpy as np
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         try:
             with server._lock:
+                blocks = server._slot_blocks.get(slot, ())
+                private = [b for b in blocks
+                           if server._block_ref[b] == 1
+                           and b not in server._block_hash]
+                blk = (private or list(blocks) or [None])[0]
                 kc = server._kc
-                if not kc.is_deleted():
-                    server._kc = kc.at[:, slot, :, 0, :].set(jnp.nan)
+                if blk is not None and not kc.is_deleted():
+                    server._kc = kc.at[:, blk, :, 0, :].set(jnp.nan)
         except RuntimeError:
             pass
         time.sleep(0.12)              # > one throttled scheduler pass
         try:
             with server._lock:
                 kc = server._kc
-                if not kc.is_deleted() and bool(np.isnan(
-                        np.asarray(kc)[:, slot]).any()):
+                if blk is not None and not kc.is_deleted() and bool(
+                        np.isnan(np.asarray(kc)[:, blk]).any()):
                     return True
         except RuntimeError:
             pass

@@ -56,6 +56,23 @@ RESILIENCE_SERIES = [
     "fleet_resumes_total",
     "kv_slots_salvaged_total",
     "kv_slots_dropped_total",
+    # paged-KV layer: block-granular salvage counters (the slot pair
+    # above stays for request-level accounting)
+    "kv_blocks_salvaged_total",
+    "kv_blocks_dropped_total",
+]
+
+# Paged KV pool + prefix cache series (PR 7): the smoke below runs two
+# same-prompt requests through a small-block server and asserts >= 1
+# real prefix hit, so hits/shared carry live values on the wire.
+PAGED_KV_SERIES = [
+    "kv_blocks_allocated_total",
+    "kv_blocks_freed_total",
+    "kv_blocks_shared_total",
+    "kv_pool_blocks_free",
+    "prefix_cache_hits_total",
+    "prefix_cache_misses_total",
+    'paged_route_total{path="reference"}',
 ]
 
 # Static-analysis subsystem series: the lint counter gets labeled
@@ -209,6 +226,26 @@ def main() -> int:
         problems.append(f"generation_server_retired_total grew "
                         f"{retired.value - retired_before} != 4")
 
+    # -- paged KV: two requests sharing one system prompt must score a
+    # real prefix-cache hit (the second prefills only its suffix) ----
+    hits = registry.counter("prefix_cache_hits_total")
+    shared = registry.counter("kv_blocks_shared_total")
+    hits_before, shared_before = hits.value, shared.value
+    sys_prompt = np.asarray([3, 1, 4, 1, 5, 9, 2, 6, 5], np.int32)
+    with GenerationServer(gpt, n_slots=2, max_len=32,
+                          block_size=4) as gs2:
+        out_a = gs2.submit(sys_prompt, n_new=4, timeout=300)
+        out_b = gs2.submit(sys_prompt, n_new=4, timeout=300)
+    if hits.value - hits_before < 1:
+        problems.append("two same-system-prompt requests produced no "
+                        "prefix_cache_hits_total increment")
+    if shared.value - shared_before < 1:
+        problems.append("prefix hit mapped no shared blocks "
+                        "(kv_blocks_shared_total flat)")
+    if not np.array_equal(out_a, out_b):
+        problems.append("prefix-hit decode diverged from the cold "
+                        "decode of the same prompt")
+
     # -- static analysis: lint series on the wire ----------------------
     emit_analysis_series(problems)
 
@@ -243,7 +280,7 @@ def main() -> int:
         "generation_server_host_syncs_total",
         'generation_server_scan_ticks_total{k="4"}',
         "generation_server_tokens_per_dispatch",
-    ] + RESILIENCE_SERIES + ANALYSIS_SERIES
+    ] + PAGED_KV_SERIES + RESILIENCE_SERIES + ANALYSIS_SERIES
     problems += missing_series(body, required)
     if lat.count - lat_before != 16:
         problems.append(

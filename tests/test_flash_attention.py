@@ -281,3 +281,52 @@ def test_attention_bthd_routes_and_falls_back():
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(ref.swapaxes(1, 2)),
                                atol=2e-5)
+
+
+# -- paged decode attention (PR 7) -------------------------------------
+def _paged_fixture(seed=0, B=3, h=4, dh=8, bs=4, mb=4, nb=9):
+    rng = np.random.default_rng(seed)
+    kpool = jnp.asarray(rng.normal(size=(nb, h, bs, dh)), jnp.float32)
+    vpool = jnp.asarray(rng.normal(size=(nb, h, bs, dh)), jnp.float32)
+    tbl = jnp.asarray(rng.integers(1, nb, (B, mb)), jnp.int32)
+    pos = jnp.asarray([3, 7, 13], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, h, dh)), jnp.float32)
+    return q, kpool, vpool, tbl, pos, 1.0 / dh ** 0.5
+
+
+def test_paged_reference_matches_stripe_math():
+    """The gather-based reference path must be BYTE-identical to the
+    stripe decode-step math on the table's contiguous view — the
+    parity contract the serving tests build on."""
+    from deeplearning4j_tpu.kernels import (paged_decode_attention,
+                                            paged_gather)
+    from deeplearning4j_tpu.kernels.paged_attention import (
+        paged_decode_attention_reference)
+    q, kp, vp, tbl, pos, scale = _paged_fixture()
+    ref = paged_decode_attention_reference(q, kp, vp, tbl, pos, scale)
+    kl, vl = paged_gather(kp, tbl), paged_gather(vp, tbl)
+    L = kl.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q[:, :, None, :],
+                   kl).astype(jnp.float32)
+    s = s * scale
+    valid = (jnp.arange(L)[None, :] <= pos[:, None])[:, None, None, :]
+    s = jnp.where(valid, s, -1e9)
+    p = jax.nn.softmax(s, -1).astype(vl.dtype)
+    stripe = jnp.einsum("bhqk,bhkd->bhqd", p, vl)[:, :, 0]
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(stripe))
+    # the public router takes the reference path off-TPU
+    out = paged_decode_attention(q, kp, vp, tbl, pos, scale=scale)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_paged_pallas_interpret_matches_reference():
+    """The Pallas kernel (interpret mode on CPU, Mosaic on TPU) agrees
+    with the reference to float tolerance, including context lengths
+    that end mid-block and unused table tails."""
+    from deeplearning4j_tpu.kernels.paged_attention import (
+        _paged_decode_pallas, paged_decode_attention_reference)
+    q, kp, vp, tbl, pos, scale = _paged_fixture()
+    ref = paged_decode_attention_reference(q, kp, vp, tbl, pos, scale)
+    out = _paged_decode_pallas(q, kp, vp, tbl, pos, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)

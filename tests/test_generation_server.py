@@ -143,6 +143,10 @@ def test_validation(net):
         GenerationServer(net, n_slots=1, top_k=5)
     with pytest.raises(ValueError, match="positional"):
         GenerationServer(net, n_slots=1, max_len=64)
+    with pytest.raises(ValueError, match="kv_blocks"):
+        # 2 blocks of 8 cannot hold one max-length (32-token) request
+        GenerationServer(net, n_slots=1, max_len=32, block_size=8,
+                         kv_blocks=2)
     with GenerationServer(net, n_slots=1, max_len=32) as srv:
         with pytest.raises(ValueError, match="slot cache length"):
             srv.submit(np.zeros(30, np.int32), n_new=10)
@@ -152,22 +156,26 @@ def test_validation(net):
             srv.submit(np.zeros((2, 4), np.int32), n_new=2)
 
 
-@pytest.mark.parametrize("tb", [1, 4, 8])
-def test_multi_tick_parity_matrix(net, offline, tb):
-    """Byte-parity at every scan batching: staggered admission with
-    mixed budgets, an EOS early-retire (mid-scan for tb > 1), and a
-    cancel through a 2-slot pool — greedy outputs must equal offline
-    ``generate()`` exactly at K=1 (the per-tick fallback) and fused
-    scans alike."""
-    rng = np.random.default_rng(tb)
+@pytest.mark.parametrize("bs,tb", [(8, 1), (8, 8), (16, 1), (16, 8)])
+def test_multi_tick_parity_matrix(net, offline, bs, tb):
+    """Byte-parity across the paged-KV matrix (block_size x scan
+    batching): staggered admission with mixed budgets, an EOS
+    early-retire (mid-scan for tb > 1), a cancel, and a shared-prefix
+    PAIR whose second request rides the prefix-cache HIT path (>= 1
+    full block at either block size) — every greedy output must equal
+    offline ``generate()`` exactly, hit and miss paths alike."""
+    rng = np.random.default_rng(31 * tb + bs)
     reqs = [(rng.integers(0, 50, t0).astype(np.int32), n_new)
             for t0, n_new in [(3, 12), (5, 7), (4, 10)]]
+    shared = rng.integers(0, 50, 17).astype(np.int32)
+    ref_shared = offline.generate(shared[None], n_new=6)[0]
     eos_prompt = np.asarray([5, 9, 2, 7], np.int32)
     ref_eos = offline.generate(eos_prompt[None], n_new=10)[0]
     eos = int(ref_eos[4 + 3])                        # retires tick 4
     first = 4 + int(np.argmax(ref_eos[4:] == eos))
     with GenerationServer(net, n_slots=2, max_len=32, tick_batch=tb,
-                          tick_timeout_s=None) as srv:
+                          block_size=bs, tick_timeout_s=None) as srv:
+        h_seed = srv.submit_async(shared, n_new=6)   # seeds the prefix
         handles = []
         for prompt, n_new in reqs:
             handles.append(srv.submit_async(prompt, n_new))
@@ -176,10 +184,15 @@ def test_multi_tick_parity_matrix(net, offline, tb):
         h_cancel = srv.submit_async(np.asarray([1, 2, 3], np.int32),
                                     n_new=20)
         assert h_cancel.cancel() is True
+        out_seed = h_seed.result(timeout=300)
+        h_hit = srv.submit_async(shared, n_new=6)    # prefix-cache hit
         outs = [h.result(timeout=300) for h in handles]
         out_eos = h_eos.result(timeout=300)
+        out_hit = h_hit.result(timeout=300)
         with pytest.raises(CancelledError):
             h_cancel.result(timeout=300)
+    np.testing.assert_array_equal(out_seed, ref_shared)
+    np.testing.assert_array_equal(out_hit, ref_shared)
     for (prompt, n_new), out in zip(reqs, outs):
         np.testing.assert_array_equal(
             out, offline.generate(prompt[None], n_new=n_new)[0])
@@ -286,6 +299,120 @@ def test_sampling_and_tick_batch_validation(net):
                        sampling={"temperature": 1.0, "top_k": 99})
 
 
+def test_pool_exhaustion_queues_on_blocks(net, offline):
+    """BLOCKS, not slots, are the scarce resource: a 4-block pool
+    (block_size=8) cannot co-run two 3-block requests even with a
+    free slot — the second verifiably waits unadmitted while the
+    first decodes, gets the retired blocks, and still decodes exactly;
+    afterwards every refcount is drained and the free list is whole."""
+    rng = np.random.default_rng(9)
+    reqs = [rng.integers(0, 50, 5).astype(np.int32) for _ in range(2)]
+    with GenerationServer(net, n_slots=2, max_len=32, block_size=8,
+                          kv_blocks=4, prefix_cache=False,
+                          tick_timeout_s=None) as srv:
+        srv.submit(reqs[0], n_new=2, timeout=300)    # warm the compiles
+        # throttle the scheduler (~0.1s/pass) so the waiting state is
+        # observable before the first request drains its budget
+        with FaultInjector([f"serve_tick_stall@{i}:0.1"
+                            for i in range(30)]):
+            hs = [srv.submit_async(p, n_new=12) for p in reqs]
+            deadline = time.monotonic() + 60
+            seen_wait = False
+            while time.monotonic() < deadline:
+                with srv._lock:
+                    n_act, n_pend = len(srv._active), len(srv._pending)
+                if n_act == 1 and n_pend == 1 and hs[0].emitted > 0:
+                    seen_wait = True     # second queued on blocks, not
+                    break                # slots (a slot is free)
+                time.sleep(0.005)
+            assert seen_wait
+            outs = [h.result(timeout=300) for h in hs]
+        with srv._lock:
+            assert int(srv._block_ref[1:].max(initial=0)) == 0
+            assert sorted(srv._blocks_free) == [1, 2, 3, 4]
+    for p, out in zip(reqs, outs):
+        np.testing.assert_array_equal(
+            out, offline.generate(p[None], n_new=12)[0])
+
+
+def test_prefix_reuse_refcounts_and_release(net, offline):
+    """Hash-keyed prefix reuse end to end: the second same-prompt
+    admission maps the cached blocks copy-free (prefix_cache_hits /
+    kv_blocks_shared count it), retire drains refcounts and parks the
+    cached blocks EVICTABLE (resident for the next hit), a cancelled
+    request's blocks drain too, and an inline tick-failure recovery
+    salvages the pool and reconciles the allocator — outputs
+    byte-identical throughout."""
+    reg = telemetry.get_registry()
+    hits = reg.counter("prefix_cache_hits_total")
+    shared_ctr = reg.counter("kv_blocks_shared_total")
+    salvaged_blocks = reg.counter("kv_blocks_salvaged_total")
+    p = np.arange(1, 14, dtype=np.int32)     # 13 tokens: 3 full blocks
+    ref = offline.generate(p[None], n_new=6)[0]
+    with GenerationServer(net, n_slots=2, max_len=32, block_size=4,
+                          tick_timeout_s=None) as srv:
+        h0, s0 = hits.value, shared_ctr.value
+        np.testing.assert_array_equal(
+            srv.submit(p, n_new=6, timeout=300), ref)
+        with srv._lock:
+            cached = dict(srv._block_hash)
+            assert len(cached) == 3              # (13-1)//4
+            assert all(srv._block_ref[b] == 0 for b in cached)
+            assert set(cached) <= set(srv._evictable)    # resident
+        np.testing.assert_array_equal(
+            srv.submit(p, n_new=6, timeout=300), ref)
+        assert hits.value - h0 == 1
+        assert shared_ctr.value - s0 == 3
+        # cancel path: an admitted request's blocks drain at the next
+        # scan boundary
+        with FaultInjector([f"serve_tick_stall@{i}:0.05"
+                            for i in range(10)]):
+            h = srv.submit_async(np.asarray([7, 8, 9], np.int32),
+                                 n_new=24)
+            deadline = time.monotonic() + 60
+            while h.emitted == 0 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert h.cancel() is True
+            with pytest.raises(CancelledError):
+                h.result(timeout=300)
+        deadline = time.monotonic() + 30
+        drained = False
+        while time.monotonic() < deadline:
+            with srv._lock:
+                drained = int(srv._block_ref[1:].max(initial=0)) == 0
+            if drained:
+                break
+            time.sleep(0.01)
+        assert drained
+        # recovery leg: force the watchdog's recovery path (_recover —
+        # same epoch bump + salvage + scheduler restart) while the
+        # scheduler sits in a chaos-site stall with the request
+        # mid-decode — the slot is salvaged (blocks + table carried
+        # over), completes byte-identical, allocator reconciled
+        sb0 = salvaged_blocks.value
+        with FaultInjector(["serve_tick_stall@0:0.3",
+                            "serve_tick_stall@1:1.5"]):
+            h = srv.submit_async(p, n_new=19)
+            deadline = time.monotonic() + 60
+            while h.emitted == 0 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert h.emitted > 0          # mid-decode, budget left
+            time.sleep(0.1)               # inside pass 1's 1.5s stall:
+                                          # pre-dispatch, so the
+                                          # committed pool is NOT
+                                          # donated and salvage reads
+                                          # it clean
+            srv._recover("test-forced recovery")
+            out = h.result(timeout=300)
+        np.testing.assert_array_equal(
+            out, offline.generate(p[None], n_new=19)[0])
+        assert salvaged_blocks.value > sb0
+        with srv._lock:
+            assert int(srv._block_ref[1:].max(initial=0)) == 0
+            n_free = len(srv._blocks_free) + len(srv._evictable)
+            assert n_free == srv.kv_blocks
+
+
 @pytest.mark.slow
 def test_multi_tick_soak_large_k(net, offline):
     """16 staggered mixed-budget requests (some EOS) through 4 slots
@@ -307,6 +434,40 @@ def test_multi_tick_soak_large_k(net, offline):
             np.testing.assert_array_equal(
                 h.result(timeout=300),
                 offline.generate(p[None], n_new=n_new)[0])
+
+
+@pytest.mark.slow
+def test_paged_shared_prefix_soak(net, offline):
+    """Block-churn soak: 12 requests through 2 slots and a TIGHT
+    6-block pool (block_size=4), alternating between two long shared
+    prefixes with unique tails — constant allocation, refcount churn,
+    prefix-cache hits AND LRU evictions under pressure; every greedy
+    output byte-identical to offline decode, allocator whole at the
+    end."""
+    rng = np.random.default_rng(11)
+    prefixes = [rng.integers(0, 50, 9).astype(np.int32)
+                for _ in range(2)]
+    with GenerationServer(net, n_slots=2, max_len=24, block_size=4,
+                          kv_blocks=6, tick_batch=8,
+                          tick_timeout_s=None) as srv:
+        reqs, handles = [], []
+        for i in range(12):
+            tail = rng.integers(0, 50, int(rng.integers(1, 4))) \
+                .astype(np.int32)
+            p = np.concatenate([prefixes[i % 2], tail])
+            n_new = int(rng.integers(3, 9))
+            reqs.append((p, n_new))
+            handles.append(srv.submit_async(p, n_new=n_new))
+            if i % 3 == 0:
+                time.sleep(0.01)
+        for (p, n_new), h in zip(reqs, handles):
+            np.testing.assert_array_equal(
+                h.result(timeout=300),
+                offline.generate(p[None], n_new=n_new)[0])
+        with srv._lock:
+            assert int(srv._block_ref[1:].max(initial=0)) == 0
+            assert (len(srv._blocks_free) + len(srv._evictable)
+                    == srv.kv_blocks)
 
 
 def test_generate_rejects_out_of_range_top_k(net):
