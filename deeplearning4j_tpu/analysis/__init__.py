@@ -9,11 +9,23 @@ analysis & sanitizers"):
   discipline (guarded attributes accessed outside their lock on
   thread-reachable paths);
 * :mod:`~deeplearning4j_tpu.analysis.graph_lint` — graph-IR validation
-  (dead vertices, arity, ``jax.eval_shape`` inference, f64 leaks).
+  (dead vertices, arity, symbolic-dim ``jax.eval_shape`` inference,
+  f64 leaks).
+
+Whole-package mode (PR 8): :mod:`~deeplearning4j_tpu.analysis.package_index`
+builds a cross-module symbol table + call graph (imports, inheritance,
+lock provenance, ``Static``/``Traced``/class-typed annotations from
+:mod:`~deeplearning4j_tpu.analysis.annotations`) with a per-file-mtime
+on-disk cache; ``jit_lint.lint_package`` walks trace contexts through
+cross-module callees (JIT106) and ``concurrency_lint.lint_package``
+checks module-level state and foreign lock-guarded attributes
+(CONC205/CONC206).
 
 CLI: ``python -m deeplearning4j_tpu.analysis`` (see
 :mod:`~deeplearning4j_tpu.analysis.cli`); CI gate:
-``scripts/lint_gate.py`` against ``ANALYSIS_BASELINE.json``.
+``scripts/lint_gate.py`` against ``ANALYSIS_BASELINE.json``
+(``--changed-only`` for pre-commit loops, ``--audit-baseline`` for
+debt hygiene).
 
 Runtime companion: :mod:`~deeplearning4j_tpu.analysis.sanitize`
 (``DL4J_TPU_SANITIZE=nan,donation``) dynamically confirms the two
@@ -23,15 +35,21 @@ from deeplearning4j_tpu.analysis.findings import (Baseline, Finding,
                                                   SEVERITIES,
                                                   sort_findings)
 from deeplearning4j_tpu.analysis import sanitize
+from deeplearning4j_tpu.analysis.annotations import Static, Traced
 from deeplearning4j_tpu.analysis.sanitize import SanitizerError
 
-__all__ = ["Baseline", "Finding", "SEVERITIES", "sort_findings",
-           "sanitize", "SanitizerError", "lint_paths", "lint_samediff",
-           "lint_computation_graph"]
+__all__ = ["Baseline", "Finding", "SEVERITIES", "Static", "Traced",
+           "sort_findings", "sanitize", "SanitizerError", "lint_paths",
+           "lint_package", "lint_samediff", "lint_computation_graph"]
 
 
 def lint_paths(*a, **kw):
     from deeplearning4j_tpu.analysis.cli import lint_paths as impl
+    return impl(*a, **kw)
+
+
+def lint_package(*a, **kw):
+    from deeplearning4j_tpu.analysis.cli import lint_package as impl
     return impl(*a, **kw)
 
 

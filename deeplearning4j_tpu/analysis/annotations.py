@@ -1,0 +1,147 @@
+"""Traced/Static parameter annotations — the lint's type language.
+
+PR 4's JIT103 guessed a parameter's trace-time nature from NAME
+heuristics (``cfg.attr`` reads look static, ``x.any()`` looks traced,
+``is None`` is static, ...).  Heuristics degrade as the hot paths grow
+— the paged decode scan branches on knobs the heuristics cannot
+classify — so this module gives authors a way to SAY it, jaxtyping
+style, and gives the linter ground truth:
+
+>>> from deeplearning4j_tpu.analysis.annotations import Static, Traced
+>>> def step(x: Traced, tick_batch: Static, cfg=None):
+...     if tick_batch > 4:        # fine: declared static
+...         ...
+...     if x.flag:                # JIT103: declared traced — the
+...         ...                   # attr-read heuristic is overridden
+
+Semantics (consumed by ``jit_lint``; the old heuristics remain the
+fallback for unannotated parameters):
+
+* ``Static`` — the parameter is a Python-level constant at trace time
+  (a config knob, a shape, a mode string).  Branching on it is
+  specialization, not a tracer leak: JIT103 never fires on it.
+* ``Traced`` — the parameter is (or contains) traced array data.
+  JIT103 fires on ANY Python branch that reads it, even through forms
+  the heuristics would excuse (attribute reads, membership tests).
+
+Both markers subscript (``Static[int]``, ``Traced["f32[b n]"]``) and
+compose with ``typing.Annotated``/string annotations — at runtime they
+are inert objects, so annotating costs nothing and imports nothing
+beyond this tiny module.  A class-typed parameter annotation (e.g.
+``server: "GenerationServer"``) is equally load-bearing: the
+cross-module concurrency pass (CONC206) resolves it through the
+package index to that class's lock/guarded-attribute facts.
+"""
+from __future__ import annotations
+
+#: Names the linter recognizes in parameter annotations.  Matching is
+#: syntactic (``Static``, ``annotations.Static``, ``Static[...]``, or
+#: the same inside a string annotation) — the linted module does not
+#: need to import anything for the annotation to be honored, though
+#: importing these keeps the annotation a real object for tooling.
+STATIC_NAMES = frozenset({"Static"})
+TRACED_NAMES = frozenset({"Traced"})
+
+
+class _Marker:
+    """Inert, subscriptable annotation marker."""
+
+    __slots__ = ("_name",)
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __repr__(self):
+        return f"deeplearning4j_tpu.analysis.annotations.{self._name}"
+
+    def __getitem__(self, item):
+        # Static[int] / Traced["f32[b n]"]: the payload is documentation
+        # for the reader; the linter keys on the marker name alone.
+        return self
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"{self._name} is an annotation marker, not a constructor; "
+            f"write 'param: {self._name}' (or '{self._name}[...]') in "
+            "the signature")
+
+
+Static = _Marker("Static")
+Traced = _Marker("Traced")
+
+
+def classify_annotation(ann_node) -> str:
+    """Classify a parameter-annotation AST node: ``"static"``,
+    ``"traced"``, a class-name string (potential CONC206 type
+    reference, e.g. ``"GenerationServer"``), or ``""`` (no verdict).
+
+    Recognized shapes: ``Static`` / ``Traced`` as a bare name, dotted
+    attribute tail, subscripted (``Static[int]``), or spelled inside a
+    string annotation; any other bare/dotted/string name whose last
+    component looks like a class name (CapWord) is returned as that
+    name for type resolution."""
+    import ast
+
+    node = ann_node
+    # string annotation: "Static", "Traced", "GenerationServer", and
+    # forward references like "Optional[GenerationServer]" (take the
+    # innermost CapWord)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        text = node.value.strip()
+        try:
+            node = ast.parse(text, mode="eval").body
+        except SyntaxError:
+            return ""
+    while isinstance(node, ast.Subscript):
+        base = node.value
+        name = _tail_name(base)
+        if name in STATIC_NAMES:
+            return "static"
+        if name in TRACED_NAMES:
+            return "traced"
+        # Optional[X] / Annotated[X, ...]: classify the first slice elt
+        # (recursing — it may itself be a string forward reference)
+        sl = node.slice
+        if isinstance(sl, ast.Tuple) and sl.elts:
+            sl = sl.elts[0]
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            return classify_annotation(sl)
+        node = sl
+    name = _tail_name(node)
+    if name in STATIC_NAMES:
+        return "static"
+    if name in TRACED_NAMES:
+        return "traced"
+    if name and name[:1].isupper() and name.isidentifier():
+        return name
+    return ""
+
+
+def _tail_name(node) -> str:
+    import ast
+    if isinstance(node, ast.Attribute):   # a.b.Static -> "Static"
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def param_annotations(fn_node):
+    """``(static_names, traced_names, type_refs)`` for a function-def
+    AST node: parameter names annotated ``Static`` / ``Traced``, and a
+    ``{param: ClassName}`` map for class-typed parameters."""
+    static, traced, types = set(), set(), {}
+    args = fn_node.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs +
+              ([args.vararg] if args.vararg else []) +
+              ([args.kwarg] if args.kwarg else [])):
+        if a.annotation is None:
+            continue
+        verdict = classify_annotation(a.annotation)
+        if verdict == "static":
+            static.add(a.arg)
+        elif verdict == "traced":
+            traced.add(a.arg)
+        elif verdict:
+            types[a.arg] = verdict
+    return static, traced, types

@@ -10,12 +10,26 @@
         --min-severity=warning    drop findings below this severity
         --telemetry               count findings into the process
                                   metrics registry
-                                  (lint_findings_total{rule=,severity=})
+                                  (lint_findings_total{rule=,severity=},
+                                  lint_modules_indexed_total,
+                                  lint_runtime_seconds)
+        --no-cross                per-module rules only (PR 4 mode)
+        --cache=FILE / --no-cache per-file-mtime index cache (default
+                                  .dl4j_lint_cache.json beside the
+                                  baseline / under the linted package)
+
+Default mode is WHOLE-PACKAGE: directory paths (and the no-path
+default, the installed package) are linted through the cross-module
+package index — per-module rules plus JIT106/CONC205/CONC206 over the
+package-wide call graph, with summaries and per-file findings cached
+by (mtime, size) so warm runs re-parse only what changed.  Explicit
+FILE paths fall back to per-module-only linting (a single file has no
+package to resolve against).
 
 Exit code: 1 when any finding is NOT covered by the baseline (all
 findings are "new" when no baseline is given), else 0.  The CI wrapper
-with diff-style reporting and ``--update-baseline`` lives in
-``scripts/lint_gate.py``.
+with diff-style reporting, ``--update-baseline``, ``--changed-only``
+and ``--audit-baseline`` lives in ``scripts/lint_gate.py``.
 """
 from __future__ import annotations
 
@@ -72,6 +86,44 @@ def lint_paths(paths: Sequence[str], rules: Sequence[str] = ("jit", "conc"),
     return findings
 
 
+def lint_package(pkg_dir: str, root: Optional[str] = None,
+                 cache_path: Optional[str] = None,
+                 rules: Sequence[str] = ("jit", "conc"),
+                 cross: bool = True):
+    """Whole-package mode: per-module findings (cached per file) plus
+    the cross-module JIT106/CONC205/CONC206 passes over the package
+    index.  Returns ``(findings, stats)``."""
+    from deeplearning4j_tpu.analysis import package_index
+    index, findings, stats = package_index.build_index(
+        pkg_dir, root=root, cache_path=cache_path)
+    if "jit" not in rules:
+        findings = [f for f in findings if not f.rule.startswith("JIT")]
+    if "conc" not in rules:
+        findings = [f for f in findings if not f.rule.startswith("CONC")]
+    if cross:
+        if "jit" in rules:
+            findings = findings + jit_lint.lint_package(index)
+        if "conc" in rules:
+            findings = findings + concurrency_lint.lint_package(index)
+    return findings, stats
+
+
+def default_cache_path(anchor_dir: str) -> str:
+    return os.path.join(anchor_dir, ".dl4j_lint_cache.json")
+
+
+def _merge_stats(total, st):
+    """Accumulate IndexStats across several linted directories so the
+    report/telemetry reflect the whole run, not the last path."""
+    if total is None:
+        return st
+    total.modules += st.modules
+    total.parsed += st.parsed
+    total.cache_hits += st.cache_hits
+    total.elapsed_s += st.elapsed_s
+    return total
+
+
 def lint_graph_file(path: str) -> List[Finding]:
     from deeplearning4j_tpu.analysis import graph_lint
     from deeplearning4j_tpu.autodiff.samediff import SameDiff
@@ -109,6 +161,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--min-severity", choices=SEVERITIES, default="info")
     ap.add_argument("--telemetry", action="store_true",
                     help="count findings into the metrics registry")
+    ap.add_argument("--no-cross", action="store_true",
+                    help="per-module rules only (skip the package "
+                         "index and JIT106/CONC205/CONC206)")
+    ap.add_argument("--cache", default=None,
+                    help="index cache file (default: "
+                         ".dl4j_lint_cache.json beside the baseline, "
+                         "or under the linted directory)")
+    ap.add_argument("--no-cache", action="store_true")
     args = ap.parse_args(argv)
 
     rules = [r.strip() for r in args.rules.split(",") if r.strip()]
@@ -125,7 +185,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     root = (os.path.dirname(os.path.abspath(args.baseline))
             if args.baseline else None)
     t0 = time.perf_counter()
-    findings = lint_paths(paths, rules=rules, root=root)
+    stats = None
+    findings = []
+    for p in paths:
+        if os.path.isdir(p):
+            # whole-package mode: cross-module index per directory
+            # (file arguments fall back per-file, per path — a stray
+            # file in the list must not demote the directories)
+            # default cache: beside the baseline when one anchors the
+            # run, else INSIDE the linted directory (never a parent
+            # the user didn't name)
+            cache = None
+            if not args.no_cache:
+                cache = args.cache or default_cache_path(
+                    root or os.path.abspath(p))
+            fs, st = lint_package(p, root=root, cache_path=cache,
+                                  rules=rules,
+                                  cross=not args.no_cross)
+            findings.extend(fs)
+            stats = _merge_stats(stats, st)
+        else:
+            findings.extend(lint_paths([p], rules=rules, root=root))
     for g in args.graph:
         findings.extend(lint_graph_file(g))
     cut = _SEV_RANK[args.min_severity]
@@ -138,14 +218,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     else:
         new, baselined, stale = findings, [], []
 
+    elapsed = time.perf_counter() - t0
     if args.telemetry:
         emit_telemetry(findings)
+        if stats is not None:
+            from deeplearning4j_tpu.analysis.package_index import (
+                emit_index_telemetry)
+            stats.elapsed_s = elapsed
+            emit_index_telemetry(stats)
 
-    elapsed = time.perf_counter() - t0
     if args.format == "json":
         print(json.dumps({
             "ok": not new,
             "elapsed_s": round(elapsed, 3),
+            "modules_indexed": stats.modules if stats else None,
+            "index_cache_hits": stats.cache_hits if stats else None,
             "counts": _counts(findings),
             "new": [f.to_dict() for f in new],
             "baselined": len(baselined),
@@ -160,10 +247,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"-- {len(stale)} stale baseline key(s) "
                   f"(fixed debt; prune with lint_gate --update-baseline)")
         c = _counts(findings)
+        idx = (f", {stats.modules} modules indexed "
+               f"({stats.cache_hits} cached)" if stats else "")
         print(f"== {len(findings)} finding(s) "
               f"({c.get('error', 0)} error, {c.get('warning', 0)} "
               f"warning, {c.get('info', 0)} info), {len(new)} new, "
-              f"in {elapsed:.2f}s")
+              f"in {elapsed:.2f}s{idx}")
     return 1 if new else 0
 
 

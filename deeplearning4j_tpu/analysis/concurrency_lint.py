@@ -46,6 +46,23 @@ CONC204 (warning) lock-free class shares mutable state: the class
                   starts a thread, has no lock at all, and an
                   attribute is written outside ``__init__`` and also
                   accessed from another checked method.
+CONC205 (error)   module-level mutable state (a module dict/list, or a
+                  ``global``-rebound name) written WITHOUT a provable
+                  lock from a function another thread can reach —
+                  thread reachability is computed over the whole
+                  package call graph (Thread targets anywhere,
+                  including cross-module ``target=mod.fn``, plus
+                  public methods of lock/thread-owning classes), so
+                  the write site and the thread spawn may live in
+                  different modules.  Emitted by :func:`lint_package`.
+CONC206 (error on store / warning on load) cross-module guarded-attr
+                  access: an object of a lock-owning class (typed via
+                  a class annotation like ``server:
+                  "GenerationServer"``, a constructor assignment, or a
+                  typed ``self.<attr>``) has one of its LOCK-GUARDED
+                  attributes accessed in a NON-owning module outside a
+                  ``with obj.<lock>:`` block.  Emitted by
+                  :func:`lint_package`.
 """
 from __future__ import annotations
 
@@ -317,3 +334,91 @@ def lint_tree(tree: ast.Module, path: str) -> List[Finding]:
 
 def lint_source(source: str, path: str = "<string>") -> List[Finding]:
     return lint_tree(ast.parse(source), path)
+
+
+# ---------------------------------------------------------------------------
+# cross-module pass (CONC205 / CONC206) over the package index
+# ---------------------------------------------------------------------------
+
+def lint_package(index) -> List[Finding]:
+    """Lock discipline the per-class pass cannot see: module-level
+    state raced by threads spawned in OTHER modules, and lock-owning
+    objects whose guarded attributes are poked from outside their
+    defining module."""
+    findings: List[Finding] = []
+    seeds = index.thread_seeds()
+    parent = index.closure(seeds)
+
+    # -- CONC205: unguarded module-state writes on thread-reachable
+    #    paths ---------------------------------------------------------
+    for fid in sorted(parent):
+        fn = index.functions[fid]
+        if not fn["module_writes"]:
+            continue
+        mod = index.func_module[fid]
+        s = index.modules[mod]
+        path = s["path"]
+        qn = fid.split("::", 1)[1]
+        mname = qn.rsplit(".", 1)[-1]
+        if mname in _EXEMPT_METHODS or mname.endswith("_locked"):
+            # same convention the per-class pass honors: a _locked
+            # suffix declares the CALLER holds the lock
+            continue
+        reported = set()
+        for line, name, guarded in fn["module_writes"]:
+            if guarded:
+                continue
+            kind = s["module_state"].get(name, {}).get("kind", "other")
+            if kind == "lock":
+                continue
+            key = (name, line)
+            if key in reported:
+                continue
+            reported.add(key)
+            chain = index.chain(parent, fid)
+            findings.append(Finding(
+                "CONC205", "error", path, line, qn,
+                f"module-level state '{name}' written without a lock "
+                f"in thread-reachable '{qn}' (reached via {chain})",
+                f"guard the write with a module-level threading.Lock "
+                f"(e.g. 'with _LOCK:'), or make '{name}' thread-local"))
+
+    # -- CONC206: guarded attrs of a foreign lock-owning class --------
+    for fid, fn in sorted(index.functions.items()):
+        if not fn["foreign"]:
+            continue
+        mod = index.func_module[fid]
+        path = index.modules[mod]["path"]
+        qn = fid.split("::", 1)[1]
+        reported = set()
+        for line, type_parts, attr, kind, locked in fn["foreign"]:
+            if locked:
+                continue
+            hit = index.resolve_class(mod, type_parts)
+            if hit is None or hit[0] == mod:
+                continue          # local class: CONC201/202 territory
+            facts = index.class_facts(*hit)
+            if attr not in facts["guarded"] or not facts["lock_attrs"]:
+                continue
+            key = (attr, kind, line)
+            if key in reported:
+                continue
+            reported.add(key)
+            owner = f"{hit[1]} ({index.modules[hit[0]]['path']})"
+            lock = sorted(facts["lock_attrs"])[0]
+            if kind == "store":
+                findings.append(Finding(
+                    "CONC206", "error", path, line, qn,
+                    f"write to '{attr}' — an attribute of {owner} "
+                    f"guarded by its '{lock}' — outside the lock, in "
+                    "a module that does not own it",
+                    f"wrap the access in 'with <obj>.{lock}:'"))
+            else:
+                findings.append(Finding(
+                    "CONC206", "warning", path, line, qn,
+                    f"read of '{attr}' — an attribute of {owner} "
+                    f"guarded by its '{lock}' — outside the lock, in "
+                    "a module that does not own it",
+                    f"read under 'with <obj>.{lock}:', or document "
+                    "why the race is benign and baseline this"))
+    return findings

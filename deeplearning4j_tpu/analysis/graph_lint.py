@@ -42,9 +42,16 @@ import numpy as np
 
 from deeplearning4j_tpu.analysis.findings import Finding
 
-#: dimension used in place of unknown (None/-1) placeholder dims during
-#: abstract evaluation — any positive size works for shape PARITY
-#: checks, and 2 keeps broadcast bugs visible where 1 would hide them.
+#: FALLBACK dimension for unknown (None/-1) placeholder dims: primary
+#: inference now propagates SYMBOLIC dimension variables through
+#: ``jax.eval_shape`` (axis 0 of every placeholder shares the batch
+#: symbol ``b``; other unknown axes get fresh ``d<i>`` symbols), so an
+#: unknown batch stays ``'b'`` in the inferred shape instead of being
+#: baked to a number — a rewrite that silently ties an output to the
+#: probe value can no longer masquerade as shape-correct.  The probe
+#: is used only when symbolic inference fails (e.g. a lowering that
+#: needs concrete sizes); 2 keeps broadcast bugs visible where 1
+#: would hide them.
 PROBE_DIM = 2
 
 
@@ -148,24 +155,81 @@ def findings_has_errors(findings: Sequence[Finding]) -> bool:
 
 
 def infer_shapes(sd, outputs: Optional[Sequence[str]] = None,
-                 probe_dim: int = PROBE_DIM) -> Dict[str, Tuple]:
+                 probe_dim: int = PROBE_DIM,
+                 symbolic: bool = True) -> Dict[str, Tuple]:
     """Abstract shape/dtype inference over a SameDiff graph via
     ``jax.eval_shape`` — no device buffers are created for
-    placeholders or activations.  Unknown placeholder dims (None/-1)
-    are probed with ``probe_dim``.  Returns ``{output_name: (shape,
-    dtype_str)}``.  Raises whatever the trace raises (callers turn
-    that into GRAPH305)."""
-    import jax
+    placeholders or activations.
 
+    Unknown placeholder dims (None/-1) become SYMBOLIC dimension
+    variables (``jax.export.symbolic_shape``): axis 0 of every
+    placeholder shares the batch symbol ``b`` (two placeholders with
+    unknown batch agree, matching how the graphs are fed), other
+    unknown axes get fresh ``d<i>`` symbols.  Symbolic output dims are
+    reported as their expression STRING (``'b'``, ``'2*b'``), which is
+    stable across calls — rewrite-parity comparisons work on graphs
+    with open batch dims.  When symbolic inference fails (a lowering
+    needing concrete sizes, or a jax without shape polymorphism) the
+    unknown dims fall back to ``probe_dim``.
+
+    Returns ``{output_name: (shape, dtype_str)}``.  Raises whatever
+    the (fallback) trace raises — callers turn that into GRAPH305."""
     outs = list(outputs) if outputs is not None else _terminal_outputs(sd)
     if not outs:
         return {}
     ph = [v for v in sd.vars.values() if v.var_type == "PLACEHOLDER"]
+    has_unknown = any(
+        d is None or int(d) < 0 for v in ph for d in (v.shape or ()))
+    if symbolic and has_unknown:
+        try:
+            return _eval_shapes(sd, outs, _symbolic_feeds(ph))
+        except Exception:
+            pass   # fall back to the probe below
     feeds = {}
+    import jax
     for v in ph:
         shape = tuple((probe_dim if (d is None or int(d) < 0) else int(d))
                       for d in (v.shape or ()))
         feeds[v.name] = jax.ShapeDtypeStruct(shape, np.dtype(v.dtype))
+    return _eval_shapes(sd, outs, feeds)
+
+
+def _symbolic_feeds(placeholders) -> Dict:
+    """ShapeDtypeStructs with symbolic dim variables for the unknown
+    dims — ONE shared scope so the batch symbol is the same variable
+    everywhere it appears."""
+    import jax
+    from jax import export
+
+    names: List[str] = []
+    templates = []              # (var, [int | name])
+    fresh = 0
+    for v in placeholders:
+        dims = []
+        for axis, d in enumerate(v.shape or ()):
+            if d is None or int(d) < 0:
+                if axis == 0:
+                    name = "b"
+                else:
+                    name = f"d{fresh}"
+                    fresh += 1
+                if name not in names:
+                    names.append(name)
+                dims.append(name)
+            else:
+                dims.append(int(d))
+        templates.append((v, dims))
+    syms = dict(zip(names, export.symbolic_shape(",".join(names)))) \
+        if names else {}
+    return {v.name: jax.ShapeDtypeStruct(
+                tuple(syms[d] if isinstance(d, str) else d
+                      for d in dims), np.dtype(v.dtype))
+            for v, dims in templates}
+
+
+def _eval_shapes(sd, outs, feeds) -> Dict[str, Tuple]:
+    import jax
+
     needed = sd._needed_for(outs)
 
     def run(feed_vals):
@@ -173,7 +237,15 @@ def infer_shapes(sd, outputs: Optional[Sequence[str]] = None,
         return [env[o] for o in outs]
 
     res = jax.eval_shape(run, feeds)
-    return {o: (tuple(r.shape), str(np.dtype(r.dtype)))
+
+    def dim(d):
+        try:
+            return int(d)
+        except Exception:        # symbolic _DimExpr: report its name
+            return str(d)
+
+    return {o: (tuple(dim(d) for d in r.shape),
+                str(np.dtype(r.dtype)))
             for o, r in zip(outs, res)}
 
 

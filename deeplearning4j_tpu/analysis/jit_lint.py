@@ -45,6 +45,21 @@ JIT105 (warning) donated-buffer reuse: an argument at a
                  ``donate_argnums`` position of a jitted call is read
                  again after the call without an intervening rebind —
                  the buffer may already be invalidated in place.
+JIT106 (error / warning) cross-module trace impurity: a host-impure
+                 call (error) or host-state mutation (warning) in a
+                 function reached FROM a trace context ACROSS a module
+                 boundary — the blind spot the per-module pass
+                 documents.  Emitted by :func:`lint_package` over the
+                 :mod:`~deeplearning4j_tpu.analysis.package_index`
+                 call graph; the finding lands on the impure function's
+                 own module with the reaching chain in the message.
+
+Annotations: parameters annotated ``Static`` / ``Traced``
+(:mod:`~deeplearning4j_tpu.analysis.annotations`) override JIT103's
+name heuristics — ``Static`` suppresses the rule for that parameter
+(like ``static_argnums``), ``Traced`` forces it even through reads the
+heuristics would excuse (attribute access, membership).  Unannotated
+parameters keep the heuristic behavior.
 """
 from __future__ import annotations
 
@@ -81,6 +96,22 @@ _STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "range",
 # any other `x.attr` in a test is treated as static config
 _TRACER_REDUCERS = {"any", "all", "item", "sum", "max", "min", "mean",
                     "prod"}
+
+
+def host_impure_detail(call: ast.Call) -> Optional[str]:
+    """The dotted name when ``call`` is a host-impure operation
+    (``time.*`` / ``random.*`` / ``np.random.*`` / ``print`` / ...)
+    — shared between the per-module JIT101 check and the
+    cross-module JIT106 fact extraction (package_index)."""
+    parts = dotted(call.func)
+    if parts is None:
+        return None
+    impure = (
+        (parts[0] in _HOST_CALL_ROOTS and len(parts) > 1)
+        or (len(parts) == 1 and parts[0] in _HOST_BUILTINS)
+        or (len(parts) >= 2 and parts[0] in ("np", "numpy")
+            and parts[1] == "random"))
+    return ".".join(parts) if impure else None
 
 
 def _is_trace_wrapper(parts: Tuple[str, ...]) -> Optional[str]:
@@ -247,10 +278,17 @@ class _ModuleLint:
                 stack.extend(ast.iter_child_nodes(n))
 
     def _lint_traced_body(self, fn: ast.AST, static_names: Set[str]):
+        from deeplearning4j_tpu.analysis.annotations import (
+            param_annotations)
         qn = self.index.qualname[fn]
         params = {a.arg for a in
                   fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs}
-        params -= static_names | {"self", "cls"}
+        # annotation convention beats the heuristics: Static params
+        # drop out entirely, Traced params are checked even through
+        # reads the heuristics would excuse
+        static_ann, traced_ann, _ = param_annotations(fn)
+        params -= static_names | static_ann | {"self", "cls"}
+        forced = traced_ann & params
         for node in self._body_nodes(fn):
             if isinstance(node, ast.Call):
                 self._check_host_call(node, qn)
@@ -265,19 +303,11 @@ class _ModuleLint:
                                    ast.AnnAssign)):
                 self._check_self_mutation(node, fn, qn)
             elif isinstance(node, (ast.If, ast.While)):
-                self._check_tracer_branch(node, params, fn, qn)
+                self._check_tracer_branch(node, params, forced, fn, qn)
 
     def _check_host_call(self, call: ast.Call, qn: str) -> None:
-        parts = dotted(call.func)
-        if parts is None:
-            return
-        name = ".".join(parts)
-        impure = (
-            (parts[0] in _HOST_CALL_ROOTS and len(parts) > 1)
-            or (len(parts) == 1 and parts[0] in _HOST_BUILTINS)
-            or (len(parts) >= 2 and parts[0] in ("np", "numpy")
-                and parts[1] == "random"))
-        if not impure:
+        name = host_impure_detail(call)
+        if name is None:
             return
         self._emit(
             "JIT101", "error", call, qn,
@@ -308,15 +338,23 @@ class _ModuleLint:
                         "hoist the caching out of the traced function")
 
     def _check_tracer_branch(self, node: ast.AST, params: Set[str],
-                             fn: ast.AST, qn: str) -> None:
+                             forced: Set[str], fn: ast.AST,
+                             qn: str) -> None:
         if not params:
             return
-        if isinstance(node, ast.If) and all(
-                isinstance(s, ast.Raise) for s in node.body):
-            # validation guard: raising at trace time is the point
-            return
-        hot = _dynamic_names(node.test)
-        bad = sorted(hot & params)
+        raise_only = isinstance(node, ast.If) and all(
+            isinstance(s, ast.Raise) for s in node.body)
+        # raise-only guards are exempt for HEURISTIC params (raising at
+        # trace time is the point of a validation guard) — but not for
+        # declared-Traced ones: `if x.flag: raise` on a tracer still
+        # fails with TracerBoolConversionError before it can raise
+        hot = set() if raise_only else _dynamic_names(node.test)
+        # a declared-Traced param fires on ANY read in the test, even
+        # through forms the heuristics treat as static (attr reads,
+        # membership): the author said it is a tracer
+        raw = {n.id for n in ast.walk(node.test)
+               if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+        bad = sorted((hot & params) | (raw & forced))
         if not bad:
             return
         kind = "if" if isinstance(node, ast.If) else "while"
@@ -435,6 +473,69 @@ def lint_tree(tree: ast.Module, path: str) -> List[Finding]:
 
 def lint_source(source: str, path: str = "<string>") -> List[Finding]:
     return lint_tree(ast.parse(source), path)
+
+
+# ---------------------------------------------------------------------------
+# cross-module pass (JIT106) over the package index
+# ---------------------------------------------------------------------------
+
+def lint_package(index) -> List[Finding]:
+    """Walk every trace context through its CROSS-MODULE callees.
+
+    Seeds are the functions each module's local pass already proves
+    traced (entries + same-module transitive closure); the package
+    call graph then carries trace-ness through imports, typed
+    attributes (``self._gen = TransformerGenerator(...)``), aliases
+    and single-hop higher-order returns.  A function that becomes
+    traced ONLY via such a cross-module edge gets JIT106 for each
+    host-impure call (error) / host-state mutation (warning) in its
+    body — the per-module JIT101/102 equivalents it was invisible to.
+    Functions the local pass already covers are skipped (no double
+    report)."""
+    findings: List[Finding] = []
+    locally_traced = set(index.traced_local_fids())
+    parent = index.closure(locally_traced)
+    for fid in sorted(parent):
+        if fid in locally_traced:
+            continue
+        fn = index.functions[fid]
+        mod = index.func_module[fid]
+        path = index.modules[mod]["path"]
+        # only report when the reaching chain really crossed a module
+        # boundary (a same-module function reached through another
+        # module and back still qualifies — its module differs from
+        # SOME ancestor on the chain)
+        cur, crossed = parent.get(fid), False
+        while cur is not None:
+            if index.func_module[cur] != mod:
+                crossed = True
+                break
+            cur = parent.get(cur)
+        if not crossed:
+            continue
+        qn = fid.split("::", 1)[1]
+        chain = index.chain(parent, fid)
+        for line, kind, detail in fn["impure"]:
+            if kind == "host_call":
+                findings.append(Finding(
+                    "JIT106", "error", path, line, qn,
+                    f"host-impure call '{detail}' in a function "
+                    f"reached from a trace context across a module "
+                    f"boundary ({chain}) — it runs once at trace "
+                    "time, not per call",
+                    "hoist the host work out of the traced call "
+                    "graph, or pass the value in"))
+            else:
+                what = (f"host-state mutation ('{detail}')"
+                        if kind == "global" else f"store to {detail}")
+                findings.append(Finding(
+                    "JIT106", "warning", path, line, qn,
+                    f"{what} in a function reached from "
+                    f"a trace context across a module boundary "
+                    f"({chain}) — it happens at trace time (once per "
+                    "compilation), not per call",
+                    "return the new value instead of mutating"))
+    return findings
 
 
 def _dynamic_names(test: ast.AST) -> Set[str]:

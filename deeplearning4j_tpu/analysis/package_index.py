@@ -1,0 +1,1015 @@
+"""Package-wide program index: symbol table + call graph + fact cache.
+
+PR 4's passes are MODULE-LOCAL: ``jit_lint`` stops a trace context at
+the file boundary and ``concurrency_lint`` only sees locks stored on
+``self`` — which makes exactly the code most likely to retrace or race
+invisible (trace contexts in ``parallel/`` calling helpers in
+``models/`` and ``kernels/``, fault-injection state in ``resilience/``
+mutated from the decode scheduler's threads).  This module builds the
+whole-package view both passes need, the way the Julia→TPU compiler
+(PAPERS: arxiv 1810.09868) proves offloadability over whole call
+graphs rather than per function:
+
+* **module summaries** — per file, a serializable digest of the facts
+  the cross-module rules consume: imports (aliases resolved to package
+  modules), function defs with their calls / host-impure operations /
+  ``Static``/``Traced``/class-typed parameter annotations
+  (:mod:`~deeplearning4j_tpu.analysis.annotations`), class defs with
+  lock provenance (``self`` locks, locks passed into ``__init__``,
+  module-level locks), thread targets, and module-level state writes;
+* **symbol table** — import-resolution across the package: a dotted
+  reference in module A resolves to the def in module B it names,
+  including ``from x import y`` chains, module aliases, class
+  inheritance folded across modules (MRO), constructor-typed
+  attributes (``self._gen = TransformerGenerator(...)``), local
+  aliases (``gen = self._gen``), and single-hop higher-order returns
+  (``pick = self._sampler(s)`` then ``pick(x)`` reaches the functions
+  ``_sampler`` returns);
+* **call graph** — edges over resolved calls, used two ways:
+  trace-context closure (``jit_lint.lint_package`` walks entries
+  through cross-module callees → JIT106) and thread-reachability
+  closure (``concurrency_lint.lint_package`` seeds from every thread
+  target / public method of a lock-owning class → CONC205/206);
+* **on-disk cache** — per-file summaries AND per-file local findings
+  keyed by (mtime, size), so the CI gate re-parses only what changed;
+  cross-module findings are recomputed from summaries every run (pure
+  dict work, milliseconds).
+
+Nothing is imported or executed from the indexed tree — pure AST
+walking, like the per-module passes.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.analysis import annotations as _ann
+from deeplearning4j_tpu.analysis.astutil import (FuncDef, FuncIndex,
+                                                 add_parents, dotted)
+
+#: bump when the summary schema changes — stale caches self-invalidate
+CACHE_VERSION = 1
+
+_MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                  "deque", "Counter"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+#: names too generic for the unique-method fallback resolution
+_FALLBACK_MIN_LEN = 4
+
+
+def module_name(relpath: str) -> str:
+    """``deeplearning4j_tpu/parallel/trainer.py`` ->
+    ``deeplearning4j_tpu.parallel.trainer`` (``__init__`` drops)."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace(os.sep, ".").replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _lockish(parts: Optional[Tuple[str, ...]]) -> bool:
+    """A dotted expr that names a lock by convention (``_LOCK``,
+    ``self._lock``, ``srv._pool_lock`` ...)."""
+    return bool(parts) and "lock" in parts[-1].lower()
+
+
+def _is_ctor_of(call: ast.Call, names: Set[str]) -> bool:
+    parts = dotted(call.func)
+    return parts is not None and parts[-1] in names
+
+
+# ---------------------------------------------------------------------------
+# per-module summary extraction
+# ---------------------------------------------------------------------------
+
+class _Extractor:
+    """One module -> serializable summary dict (see module docstring)."""
+
+    def __init__(self, tree: ast.Module, relpath: str, modname: str):
+        self.tree = tree
+        self.relpath = relpath
+        self.modname = modname
+        # an __init__.py IS its package: relative imports anchor at
+        # modname itself, not at its parent like a plain module's do
+        self.is_package = os.path.basename(relpath) == "__init__.py"
+        self.parents = add_parents(tree)
+        self.findex = FuncIndex(tree, self.parents)
+
+    def run(self) -> Dict:
+        imports = self._imports()
+        classes = self._classes()
+        module_state, module_locks = self._module_state()
+        functions: Dict[str, Dict] = {}
+        for fn in self.findex.defs:
+            qn = self.findex.qualname[fn]
+            env = self._inherited_env(fn, classes)
+            functions[qn] = self._function(fn, qn, env, classes,
+                                           module_state, module_locks)
+        traced_local = self._traced_local()
+        return {
+            "module": self.modname,
+            "path": self.relpath,
+            "imports": imports,
+            "classes": classes,
+            "functions": functions,
+            "module_state": module_state,
+            "module_locks": sorted(module_locks),
+            "thread_target_fns": self._module_thread_targets(),
+            "traced_local": traced_local,
+        }
+
+    # -- imports -------------------------------------------------------
+    def _imports(self) -> Dict[str, List]:
+        """alias -> [module, attr-or-None].  ``import a.b.c`` binds
+        ``a`` (resolution walks the chain); relative imports resolve
+        against this module's package."""
+        out: Dict[str, List] = {}
+        if self.is_package:
+            pkg = self.modname
+        else:
+            pkg = self.modname.rsplit(".", 1)[0] \
+                if "." in self.modname else self.modname
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        out[alias.asname] = [alias.name, None]
+                    else:
+                        out[alias.name.split(".")[0]] = \
+                            [alias.name.split(".")[0], None]
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    up = pkg.split(".")
+                    up = up[: len(up) - (node.level - 1)]
+                    base = ".".join(up + ([base] if base else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    out[alias.asname or alias.name] = [base, alias.name]
+        return out
+
+    # -- classes -------------------------------------------------------
+    def _classes(self) -> Dict[str, Dict]:
+        from deeplearning4j_tpu.analysis import concurrency_lint as _cl
+        scanner = _cl._ModuleLint(self.tree, self.relpath)
+        out: Dict[str, Dict] = {}
+        for node in self.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = scanner._scan_class(node)
+            out[node.name] = {
+                "line": node.lineno,
+                "bases": [list(p) for p in
+                          (dotted(b) for b in node.bases) if p],
+                "methods": sorted(ci.methods),
+                "lock_attrs": sorted(ci.lock_attrs),
+                "guarded": sorted(ci.guarded),
+                "thread_targets": sorted(ci.thread_targets),
+                "starts_threads": ci.starts_threads,
+                "attr_types": self._attr_types(node),
+            }
+        return out
+
+    def _attr_types(self, cls: ast.ClassDef) -> Dict[str, List[str]]:
+        """``self.X = Cls(...)`` and ``self.X = <typed param>`` give
+        the attribute a class type the resolver can use."""
+        out: Dict[str, List[str]] = {}
+        for m in cls.body:
+            if not isinstance(m, FuncDef):
+                continue
+            _, _, ptypes = _ann.param_annotations(m)
+            for n in ast.walk(m):
+                if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+                    continue
+                t = dotted(n.targets[0])
+                if not (t and t[0] == "self" and len(t) == 2):
+                    continue
+                if isinstance(n.value, ast.Call):
+                    cp = dotted(n.value.func)
+                    if cp and cp[-1][:1].isupper():
+                        out[t[1]] = list(cp)
+                elif isinstance(n.value, ast.Name) and \
+                        n.value.id in ptypes:
+                    out[t[1]] = [ptypes[n.value.id]]
+        return out
+
+    # -- module-level state --------------------------------------------
+    def _module_state(self) -> Tuple[Dict[str, Dict], Set[str]]:
+        state: Dict[str, Dict] = {}
+        locks: Set[str] = set()
+        for node in self.tree.body:
+            targets: List[ast.AST] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                kind = "other"
+                if isinstance(value, ast.Call) and \
+                        _is_ctor_of(value, _LOCK_CTORS):
+                    kind = "lock"
+                    locks.add(t.id)
+                elif isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                        ast.ListComp, ast.DictComp,
+                                        ast.SetComp)) or \
+                        (isinstance(value, ast.Call) and
+                         _is_ctor_of(value, _MUTABLE_CTORS)):
+                    kind = "mutable"
+                state[t.id] = {"line": t.lineno, "kind": kind}
+        return state, locks
+
+    def _module_thread_targets(self) -> List[List[str]]:
+        """``threading.Thread(target=X)`` where X is NOT ``self.meth``
+        — a module function or an imported one (cross-module thread
+        target, invisible to the per-class pass)."""
+        out: List[List[str]] = []
+        for n in ast.walk(self.tree):
+            if not (isinstance(n, ast.Call) and
+                    (p := dotted(n.func)) and p[-1] == "Thread"):
+                continue
+            for kw in n.keywords:
+                if kw.arg != "target":
+                    continue
+                tp = dotted(kw.value)
+                if tp and tp[0] not in ("self", "cls"):
+                    out.append(list(tp))
+        return out
+
+    # -- trace entries (local pass's view) -----------------------------
+    def _traced_local(self) -> Dict[str, List[str]]:
+        from deeplearning4j_tpu.analysis import jit_lint as _jl
+        lint = _jl._ModuleLint(self.tree, self.relpath)
+        lint.collect_entries()
+        return {lint.index.qualname[fn]: sorted(static)
+                for fn, static in lint.traced.items()}
+
+    # -- per-function facts --------------------------------------------
+    def _inherited_env(self, fn: ast.AST, classes: Dict) -> Dict:
+        """Type/alias environment inherited from enclosing functions
+        (closures see the outer scope's ``gen = self._gen``)."""
+        chain: List[ast.AST] = []
+        cur = self.parents.get(fn)
+        while cur is not None:
+            if isinstance(cur, FuncDef):
+                chain.append(cur)
+            cur = self.parents.get(cur)
+        env: Dict = {"types": {}, "via": {}}
+        for outer in reversed(chain):
+            oenv = self._local_env(outer, classes)
+            env["types"].update(oenv["types"])
+            env["via"].update(oenv["via"])
+        return env
+
+    def _owner_attr_types(self, fn: ast.AST, classes: Dict) -> Dict:
+        cls = self.findex.owner_class.get(fn)
+        if cls is None:
+            # nested functions: the enclosing method's class
+            cur = self.parents.get(fn)
+            while cur is not None and cls is None:
+                if isinstance(cur, FuncDef):
+                    cls = self.findex.owner_class.get(cur)
+                cur = self.parents.get(cur)
+        if cls is None:
+            return {}
+        return classes.get(cls.name, {}).get("attr_types", {})
+
+    def _local_env(self, fn: ast.AST, classes: Dict) -> Dict:
+        """types: var -> class-ref parts; via: var -> callee parts
+        whose RETURNED functions the var aliases."""
+        types: Dict[str, List[str]] = {}
+        via: Dict[str, List[str]] = {}
+        _, _, ptypes = _ann.param_annotations(fn)
+        for p, cname in ptypes.items():
+            types[p] = [cname]
+        attr_types = self._owner_attr_types(fn, classes)
+        for n in self._body(fn):
+            if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+                continue
+            if not isinstance(n.targets[0], ast.Name):
+                continue
+            name = n.targets[0].id
+            v = n.value
+            if isinstance(v, ast.Call):
+                cp = dotted(v.func)
+                if cp and cp[-1][:1].isupper():
+                    types[name] = list(cp)        # v = Cls(...)
+                elif cp:
+                    via[name] = list(cp)          # v = f(...): returns
+            else:
+                vp = dotted(v)
+                if vp and vp[0] == "self" and len(vp) == 2 and \
+                        vp[1] in attr_types:
+                    types[name] = attr_types[vp[1]]   # v = self._gen
+        return {"types": types, "via": via}
+
+    def _body(self, fn: ast.AST):
+        """fn's own statements, excluding nested function bodies."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            yield n
+            if not isinstance(n, FuncDef + (ast.Lambda,)):
+                stack.extend(ast.iter_child_nodes(n))
+
+    def _locked_nodes(self, fn: ast.AST,
+                      module_locks: Set[str] = frozenset()
+                      ) -> Dict[int, List[Tuple]]:
+        """id(node) -> [lock parts] for nodes inside ``with <lock>:``
+        blocks — a lock either by NAME convention (``_LOCK``,
+        ``self._lock``, ``server._pool_lock``) or by module-level
+        CONSTRUCTOR provenance (``_MUTEX = threading.Lock()`` counts
+        even though nothing in the name says so)."""
+        def is_lock(parts) -> bool:
+            return _lockish(parts) or (
+                parts is not None and len(parts) == 1
+                and parts[0] in module_locks)
+
+        out: Dict[int, List[Tuple]] = {}
+        for n in self._body(fn):
+            if not isinstance(n, ast.With):
+                continue
+            lock_parts = [dotted(i.context_expr) for i in n.items
+                          if is_lock(dotted(i.context_expr))]
+            if not lock_parts:
+                continue
+            for stmt in n.body:
+                for sub in ast.walk(stmt):
+                    out.setdefault(id(sub), []).extend(lock_parts)
+        return out
+
+    def _function(self, fn: ast.AST, qn: str, inherited: Dict,
+                  classes: Dict, module_state: Dict,
+                  module_locks: Set[str]) -> Dict:
+        from deeplearning4j_tpu.analysis import jit_lint as _jl
+        env = self._local_env(fn, classes)
+        types = dict(inherited["types"]); types.update(env["types"])
+        via = dict(inherited["via"]); via.update(env["via"])
+        attr_types = self._owner_attr_types(fn, classes)
+        locked = self._locked_nodes(fn, module_locks)
+        owner = self.findex.owner_class.get(fn)
+
+        calls: List[Dict] = []
+        impure: List[List] = []
+        module_writes: List[List] = []
+        foreign: List[List] = []
+        globals_declared: Set[str] = set()
+        local_stores: Set[str] = set()
+        returns_fns: List[str] = []
+
+        def type_of_base(node: ast.AST) -> Optional[List[str]]:
+            p = dotted(node)
+            if p is None:
+                return None
+            if len(p) == 1 and p[0] in types:
+                return types[p[0]]
+            if len(p) == 2 and p[0] == "self" and p[1] in attr_types:
+                return attr_types[p[1]]
+            return None
+
+        def base_locked(node: ast.AST, base: ast.AST) -> bool:
+            """access ``base.attr`` inside ``with base.<lock>:``?"""
+            bp = dotted(base)
+            for lp in locked.get(id(node), ()):
+                if lp and tuple(lp[:-1]) == tuple(bp or ()):
+                    return True
+            return False
+
+        # pass 0: global declarations first (they change how stores in
+        # the later passes classify)
+        for n in self._body(fn):
+            if isinstance(n, ast.Global):
+                globals_declared.update(n.names)
+                impure.append([n.lineno, "global",
+                               "global " + ", ".join(n.names)])
+
+        for n in self._body(fn):
+            if isinstance(n, FuncDef):
+                pass
+            elif isinstance(n, ast.Call):
+                detail = _jl.host_impure_detail(n)
+                if detail:
+                    impure.append([n.lineno, "host_call", detail])
+                cp = dotted(n.func)
+                if cp is not None:
+                    entry: Dict = {"line": n.lineno}
+                    base_t = None
+                    if len(cp) >= 2:
+                        base_t = type_of_base(n.func.value) \
+                            if isinstance(n.func, ast.Attribute) else None
+                    if base_t is not None:
+                        entry["type"] = base_t
+                        entry["meth"] = cp[-1]
+                    elif len(cp) == 1 and cp[0] in via:
+                        entry["via"] = via[cp[0]]
+                    else:
+                        entry["parts"] = list(cp)
+                    calls.append(entry)
+            elif isinstance(n, ast.Return) and n.value is not None:
+                vals = [n.value]
+                if isinstance(n.value, ast.IfExp):
+                    vals = [n.value.body, n.value.orelse]
+                for v in vals:
+                    if isinstance(v, ast.Name):
+                        hit = self.findex.resolve_name(v.id, n)
+                        if hit is not None:
+                            returns_fns.append(self.findex.qualname[hit])
+
+        # stores: self mutations, module-state writes
+        def _store_targets(n):
+            if isinstance(n, ast.Assign):
+                return list(n.targets)
+            if isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                return [n.target]
+            if isinstance(n, ast.Delete):
+                return list(n.targets)
+            return []
+
+        # pass 1: which names are plain local binds (shadowing) —
+        # parameters shadow module state exactly like assignments do
+        a = fn.args
+        for p in (a.posonlyargs + a.args + a.kwonlyargs +
+                  ([a.vararg] if a.vararg else []) +
+                  ([a.kwarg] if a.kwarg else [])):
+            local_stores.add(p.arg)
+        for n in self._body(fn):
+            for t in _store_targets(n):
+                for tt in ast.walk(t):
+                    if isinstance(tt, ast.Name) and \
+                            isinstance(tt.ctx, (ast.Store, ast.Del)) \
+                            and tt.id not in globals_declared:
+                        local_stores.add(tt.id)
+        # pass 2: module-state writes + self-mutations
+        self_store_bases: Set[int] = set()
+        for n in self._body(fn):
+            guard = bool(locked.get(id(n)))
+            for t in _store_targets(n):
+                for tt in ast.walk(t):
+                    if isinstance(tt, ast.Name) and \
+                            isinstance(tt.ctx, (ast.Store, ast.Del)) \
+                            and tt.id in globals_declared:
+                        module_writes.append([tt.lineno, tt.id, guard])
+                    if isinstance(tt, ast.Subscript) and \
+                            isinstance(tt.value, ast.Name):
+                        name = tt.value.id
+                        if name in globals_declared or (
+                                name in module_state and
+                                name not in local_stores):
+                            module_writes.append([tt.lineno, name,
+                                                  guard])
+                    # self.<attr> (incl. element stores) = trace-time
+                    # host mutation when reached from a trace context.
+                    # The walk visits both `self.buf[0]` and the inner
+                    # `self.buf` — dedupe on the Attribute node itself
+                    # so one statement yields one fact.
+                    base = tt
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if isinstance(base, ast.Attribute) and \
+                            isinstance(base.value, ast.Name) and \
+                            base.value.id == "self" and \
+                            id(base) not in self_store_bases:
+                        self_store_bases.add(id(base))
+                        impure.append([base.lineno, "self_store",
+                                       f"self.{base.attr}"])
+
+        # foreign typed-object attribute accesses (CONC206 facts)
+        for n in self._body(fn):
+            if not isinstance(n, ast.Attribute):
+                continue
+            base_t = type_of_base(n.value)
+            if base_t is None:
+                continue
+            if _lockish((n.attr,)):
+                continue                 # the lock itself
+            parent = self.parents.get(n)
+            if isinstance(parent, ast.Call) and parent.func is n:
+                continue                 # method call: API use, not state
+            kind = "store" if isinstance(n.ctx, (ast.Store, ast.Del)) \
+                else "load"
+            if kind == "load":
+                # element store through the attribute
+                pp = self.parents.get(n)
+                if isinstance(pp, ast.Subscript) and \
+                        isinstance(pp.ctx, (ast.Store, ast.Del)):
+                    kind = "store"
+            foreign.append([n.lineno, base_t, n.attr, kind,
+                            base_locked(n, n.value)])
+
+        static_ann, traced_ann, ptypes = _ann.param_annotations(fn)
+        return {
+            "line": fn.lineno,
+            "cls": owner.name if owner is not None else None,
+            "nested": [self.findex.qualname[d]
+                       for d in self.findex.scope_children.get(fn, {})
+                       .values()],
+            "static_ann": sorted(static_ann),
+            "traced_ann": sorted(traced_ann),
+            "param_types": ptypes,
+            "calls": calls,
+            "impure": impure,
+            "module_writes": module_writes,
+            "foreign": foreign,
+            "returns_fns": sorted(set(returns_fns)),
+        }
+
+
+def summarize_module(tree: ast.Module, relpath: str,
+                     modname: Optional[str] = None) -> Dict:
+    return _Extractor(tree, relpath,
+                      modname or module_name(relpath)).run()
+
+
+# ---------------------------------------------------------------------------
+# the index
+# ---------------------------------------------------------------------------
+
+class PackageIndex:
+    """Cross-module resolution over a set of module summaries.
+
+    Function ids are ``"<module>::<qualname>"``; class ids are
+    ``(module, ClassName)``.  All resolution is best-effort and
+    returns nothing rather than guessing wildly — the one deliberate
+    heuristic is the unique-method fallback (an ``obj.meth(...)`` call
+    resolves when exactly one class in the whole package defines
+    ``meth`` and the name is specific enough), which trace/thread
+    closures need for duck-typed callees."""
+
+    def __init__(self, summaries: Dict[str, Dict]):
+        #: module name -> summary
+        self.modules = summaries
+        self.functions: Dict[str, Dict] = {}
+        self.func_module: Dict[str, str] = {}
+        self._methods_by_name: Dict[str, List[str]] = {}
+        self._classes_by_name: Dict[str, List[Tuple[str, str]]] = {}
+        for mod, s in summaries.items():
+            for qn, f in s["functions"].items():
+                fid = f"{mod}::{qn}"
+                self.functions[fid] = f
+                self.func_module[fid] = mod
+                if f["cls"] is not None:
+                    self._methods_by_name.setdefault(
+                        qn.rsplit(".", 1)[-1], []).append(fid)
+            for cname in s["classes"]:
+                self._classes_by_name.setdefault(cname, []).append(
+                    (mod, cname))
+
+    # -- stats ----------------------------------------------------------
+    @property
+    def n_modules(self) -> int:
+        return len(self.modules)
+
+    # -- symbol resolution ---------------------------------------------
+    def resolve_import(self, mod: str, name: str
+                       ) -> Optional[Tuple[str, Optional[str]]]:
+        """An imported alias in ``mod`` -> (target module, attr|None),
+        following one re-export hop (``from a import b`` where ``a``
+        itself imported ``b`` from elsewhere)."""
+        s = self.modules.get(mod)
+        if s is None:
+            return None
+        hit = s["imports"].get(name)
+        if hit is None:
+            return None
+        base, attr = hit
+        if attr is None:
+            return (base, None)
+        sub = f"{base}.{attr}"
+        if sub in self.modules:
+            return (sub, None)
+        if base in self.modules:
+            tgt = self.modules[base]
+            if attr in tgt["functions"] or attr in tgt["classes"]:
+                return (base, attr)
+            # re-export hop (package __init__)
+            re_hit = tgt["imports"].get(attr)
+            if re_hit is not None:
+                b2, a2 = re_hit
+                if a2 is None:
+                    return (b2, None) if b2 in self.modules else None
+                if f"{b2}.{a2}" in self.modules:
+                    return (f"{b2}.{a2}", None)
+                if b2 in self.modules:
+                    return (b2, a2)
+        return (base, attr)
+
+    def resolve_class(self, mod: str, parts: Sequence[str],
+                      _depth: int = 0) -> Optional[Tuple[str, str]]:
+        """A class reference (possibly dotted / imported / unique-named
+        elsewhere in the package) -> (module, ClassName)."""
+        if _depth > 8:
+            return None
+        parts = list(parts)
+        s = self.modules.get(mod)
+        if s is not None and len(parts) == 1 and parts[0] in s["classes"]:
+            return (mod, parts[0])
+        if s is not None and parts:
+            hop = self.resolve_import(mod, parts[0])
+            if hop is not None:
+                tmod, attr = hop
+                rest = ([attr] if attr else []) + parts[1:]
+                if not rest:
+                    return None
+                if len(rest) == 1 and tmod in self.modules and \
+                        rest[0] in self.modules[tmod]["classes"]:
+                    return (tmod, rest[0])
+                if tmod in self.modules:
+                    return self.resolve_class(tmod, rest, _depth + 1)
+                # walk module chain: tmod.a.b.Cls
+                chain, cls = rest[:-1], rest[-1]
+                target = tmod + ("." + ".".join(chain) if chain else "")
+                if target in self.modules and \
+                        cls in self.modules[target]["classes"]:
+                    return (target, cls)
+        # unique name across the package
+        cands = self._classes_by_name.get(parts[-1], [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def class_mro(self, mod: str, cname: str,
+                  _depth: int = 0) -> List[Tuple[str, str]]:
+        """(module, class) chain, subclass first, bases folded across
+        modules."""
+        out = [(mod, cname)]
+        if _depth > 6:
+            return out
+        cls = self.modules.get(mod, {}).get("classes", {}).get(cname)
+        for bp in (cls or {}).get("bases", []):
+            hit = self.resolve_class(mod, bp)
+            if hit is not None and hit not in out:
+                out.extend(h for h in
+                           self.class_mro(hit[0], hit[1], _depth + 1)
+                           if h not in out)
+        return out
+
+    def class_facts(self, mod: str, cname: str) -> Dict:
+        """Lock/guard facts with cross-module bases folded in."""
+        lock_attrs: Set[str] = set()
+        guarded: Set[str] = set()
+        for m, c in self.class_mro(mod, cname):
+            cls = self.modules.get(m, {}).get("classes", {}).get(c)
+            if cls:
+                lock_attrs.update(cls["lock_attrs"])
+                guarded.update(cls["guarded"])
+        return {"lock_attrs": lock_attrs, "guarded": guarded}
+
+    def resolve_method(self, mod: str, cname: str, meth: str
+                       ) -> Optional[str]:
+        for m, c in self.class_mro(mod, cname):
+            fid = f"{m}::{c}.{meth}"
+            if fid in self.functions:
+                return fid
+            # nested classes / multi-level qualnames — require a dot
+            # boundary (ThreadServer.run must not satisfy Server.run)
+            s = self.modules.get(m)
+            if s:
+                for qn in s["functions"]:
+                    if qn == f"{c}.{meth}" or \
+                            qn.endswith(f".{c}.{meth}"):
+                        return f"{m}::{qn}"
+        return None
+
+    def resolve_module_fn(self, mod: str, parts: Sequence[str]
+                          ) -> Optional[str]:
+        """A non-method dotted call -> fid, through import aliases and
+        module chains."""
+        parts = list(parts)
+        s = self.modules.get(mod)
+        if s is None or not parts:
+            return None
+        if len(parts) == 1:
+            # top-level def in this module (any enclosing scope)
+            if parts[0] in s["functions"]:
+                return f"{mod}::{parts[0]}"
+            hop = self.resolve_import(mod, parts[0])
+            if hop is not None:
+                tmod, attr = hop
+                if attr is not None and tmod in self.modules and \
+                        attr in self.modules[tmod]["functions"]:
+                    return f"{tmod}::{attr}"
+            return None
+        hop = self.resolve_import(mod, parts[0])
+        if hop is not None:
+            tmod, attr = hop
+            rest = ([attr] if attr else []) + parts[1:]
+            chain, fn = rest[:-1], rest[-1]
+            target = tmod + ("." + ".".join(chain) if chain else "")
+            if target in self.modules and \
+                    fn in self.modules[target]["functions"]:
+                return f"{target}::{fn}"
+            # attr of an imported CLASS (Cls.method reference)
+            if tmod in self.modules and chain and \
+                    chain[0] in self.modules[tmod]["classes"]:
+                return self.resolve_method(tmod, chain[0], fn)
+        return None
+
+    def resolve_call(self, fid: str, call: Dict) -> List[str]:
+        """A recorded call entry -> candidate callee fids."""
+        mod = self.func_module[fid]
+        fn = self.functions[fid]
+        if "type" in call:
+            hit = self.resolve_class(mod, call["type"])
+            if hit is None:
+                return []
+            m = self.resolve_method(hit[0], hit[1], call["meth"])
+            return [m] if m else []
+        if "via" in call:
+            # pick = self._sampler(s); pick(x) -> _sampler's returns
+            target = self._resolve_parts(fid, call["via"])
+            out: List[str] = []
+            for t in target:
+                tmod = self.func_module[t]
+                for rqn in self.functions[t].get("returns_fns", ()):
+                    rfid = f"{tmod}::{rqn}"
+                    if rfid in self.functions:
+                        out.append(rfid)
+            return out
+        return self._resolve_parts(fid, call.get("parts", []))
+
+    def _resolve_parts(self, fid: str, parts: Sequence[str]
+                       ) -> List[str]:
+        mod = self.func_module[fid]
+        cls = self.functions[fid]["cls"]
+        return self.resolve_in_module(mod, parts, cls=cls)
+
+    def resolve_in_module(self, mod: str, parts: Sequence[str],
+                          cls: Optional[str] = None) -> List[str]:
+        """Resolve a dotted reference as seen from ``mod`` (optionally
+        from inside class ``cls``) — the fid-free core used both for
+        calls and for module-level Thread targets."""
+        if not parts or mod not in self.modules:
+            return []
+        parts = list(parts)
+        if parts[0] in ("self", "cls") and len(parts) == 2 and cls:
+            m = self.resolve_method(mod, cls, parts[1])
+            return [m] if m else []
+        if parts[0] in ("self", "cls"):
+            return []
+        hit = self.resolve_module_fn(mod, parts)
+        if hit is not None:
+            return [hit]
+        if len(parts) == 1:
+            # a sibling method referenced bare inside its own class
+            # scope resolves through FuncIndex at extraction; here a
+            # bare unresolved name is a builtin or external — skip.
+            # (local defs are in functions under their qualname tail)
+            s = self.modules[mod]
+            cands = [qn for qn in s["functions"]
+                     if qn == parts[0] or qn.endswith("." + parts[0])]
+            if len(cands) == 1:
+                return [f"{mod}::{cands[0]}"]
+            return []
+        # unique-method fallback: obj.meth(...) with exactly one
+        # candidate class method in the whole package.  Never applied
+        # when the call is rooted at an imported name that resolved to
+        # nothing above — ``np.dtype(...)`` targets numpy, not the one
+        # package class that happens to define a ``dtype`` method.
+        if parts[0] in self.modules[mod]["imports"]:
+            return []
+        meth = parts[-1]
+        if len(meth) >= _FALLBACK_MIN_LEN or meth.startswith("_"):
+            cands = self._methods_by_name.get(meth, [])
+            if len(cands) == 1:
+                return [cands[0]]
+        return []
+
+    # -- closures -------------------------------------------------------
+    def closure(self, seeds: Iterable[str]
+                ) -> Dict[str, Optional[str]]:
+        """Call-graph closure from ``seeds``: fid -> predecessor fid
+        (None for seeds).  Nested defs ride along with their parent.
+
+        Deterministic BFS over SORTED seeds/neighbors: every run
+        assigns the same (shortest, ties lexicographic) predecessor
+        chain, so the reach chains rendered into finding messages —
+        and therefore baseline keys — are stable across processes
+        (str hash randomization must not leak into the report)."""
+        from collections import deque
+        parent: Dict[str, Optional[str]] = {}
+        frontier = deque(sorted(
+            s for s in set(seeds) if s in self.functions))
+        for s in frontier:
+            parent.setdefault(s, None)
+        while frontier:
+            fid = frontier.popleft()
+            f = self.functions[fid]
+            mod = self.func_module[fid]
+            nxt: List[str] = []
+            for call in f["calls"]:
+                nxt.extend(self.resolve_call(fid, call))
+            nxt.extend(f"{mod}::{qn}" for qn in f.get("nested", ()))
+            for t in sorted(set(nxt)):
+                if t in self.functions and t not in parent:
+                    parent[t] = fid
+                    frontier.append(t)
+        return parent
+
+    def chain(self, parent: Dict[str, Optional[str]], fid: str,
+              limit: int = 4) -> str:
+        """Render ``seed -> ... -> fid`` (shortened) for messages."""
+        hops = [fid]
+        cur = parent.get(fid)
+        while cur is not None and len(hops) < 32:
+            hops.append(cur)
+            cur = parent.get(cur)
+        hops.reverse()
+        if len(hops) > limit:
+            hops = hops[:1] + ["..."] + hops[-(limit - 1):]
+        return " -> ".join(h if h == "..." else self.render_fid(h)
+                           for h in hops)
+
+    def render_fid(self, fid: str) -> str:
+        mod, qn = fid.split("::", 1)
+        return f"{self.modules[mod]['path']}::{qn}"
+
+    # -- thread seeds ---------------------------------------------------
+    def thread_seeds(self) -> List[str]:
+        """Every function another thread can enter: Thread targets
+        (``self`` methods AND module/imported functions), plus public
+        methods of classes that start threads or own locks."""
+        seeds: List[str] = []
+        for mod, s in self.modules.items():
+            for cname, cls in s["classes"].items():
+                entries = set(cls["thread_targets"])
+                if cls["starts_threads"] or cls["lock_attrs"]:
+                    entries |= {m for m in cls["methods"]
+                                if not m.startswith("_")}
+                for m in entries:
+                    fid = self.resolve_method(mod, cname, m)
+                    if fid:
+                        seeds.append(fid)
+            for tp in s["thread_target_fns"]:
+                # resolve in the module that spawns the thread — a
+                # launcher module with no defs of its own still seeds
+                seeds.extend(self.resolve_in_module(mod, tp))
+        return seeds
+
+    # -- trace seeds ----------------------------------------------------
+    def traced_local_fids(self) -> Dict[str, List[str]]:
+        out: Dict[str, List[str]] = {}
+        for mod, s in self.modules.items():
+            for qn, static in s["traced_local"].items():
+                out[f"{mod}::{qn}"] = static
+        return out
+
+
+# ---------------------------------------------------------------------------
+# build + cache
+# ---------------------------------------------------------------------------
+
+def _iter_py(pkg_dir: str) -> Iterable[str]:
+    for root, dirs, files in os.walk(pkg_dir):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", ".git"))
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+class IndexStats:
+    def __init__(self):
+        self.modules = 0
+        self.parsed = 0
+        self.cache_hits = 0
+        self.elapsed_s = 0.0
+
+
+def build_index(pkg_dir: str, root: Optional[str] = None,
+                cache_path: Optional[str] = None,
+                run_local_passes: bool = True
+                ) -> Tuple["PackageIndex", List, IndexStats]:
+    """Index every module under ``pkg_dir``.
+
+    Returns ``(index, local_findings, stats)`` — local findings are the
+    per-module jit/concurrency passes' output, cached per file beside
+    the summaries; cross-module findings are computed by the callers
+    (``jit_lint.lint_package`` / ``concurrency_lint.lint_package``)
+    from the returned index."""
+    import time as _time
+    from deeplearning4j_tpu.analysis import concurrency_lint, jit_lint
+    from deeplearning4j_tpu.analysis.findings import Finding
+
+    t0 = _time.perf_counter()
+    root = os.path.abspath(root or os.getcwd())
+    # reported paths are root-relative (baseline keys), but MODULE
+    # NAMES must anchor where the package's own imports do — linting a
+    # directory outside `root` (scratch trees, tmp fixtures) must
+    # still resolve its internal imports.  A package directory is
+    # imported fully qualified, so walk UP through the whole
+    # __init__.py chain (linting `pkg/sub/` must name modules
+    # `pkg.sub.x` or the subpackage's absolute imports of itself never
+    # resolve); a flat directory of modules imports its siblings bare
+    # (`from b import helper`), so names anchor at the directory.
+    modbase = os.path.abspath(pkg_dir)
+    while os.path.exists(os.path.join(modbase, "__init__.py")):
+        parent = os.path.dirname(modbase)
+        if parent == modbase:
+            break
+        modbase = parent
+    stats = IndexStats()
+
+    cache: Dict = {"version": CACHE_VERSION, "files": {}}
+    if cache_path and os.path.exists(cache_path):
+        try:
+            with open(cache_path) as fh:
+                loaded = json.load(fh)
+            if loaded.get("version") == CACHE_VERSION:
+                cache = loaded
+        except (OSError, ValueError):
+            pass
+
+    summaries: Dict[str, Dict] = {}
+    local_findings: List = []
+    files_out: Dict[str, Dict] = {}
+    for path in _iter_py(pkg_dir):
+        rel = os.path.relpath(os.path.abspath(path), root)
+        modname = module_name(
+            os.path.relpath(os.path.abspath(path), modbase))
+        st = os.stat(path)
+        stats.modules += 1
+        entry = cache["files"].get(rel)
+        # a hit must ALSO have been summarized under the same module
+        # name — a cache shared between runs with different anchors
+        # (subpackage vs whole package) must not inject truncated
+        # names that silently break import resolution
+        if entry is not None and entry["mtime"] == st.st_mtime and \
+                entry["size"] == st.st_size and \
+                entry["summary"]["module"] == modname:
+            stats.cache_hits += 1
+            summaries[entry["summary"]["module"]] = entry["summary"]
+            local_findings.extend(
+                Finding.from_dict(d) for d in entry["findings"])
+            files_out[rel] = entry
+            continue
+        stats.parsed += 1
+        try:
+            with open(path, "rb") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError as e:
+            f = Finding(rule="PARSE000", severity="error", path=rel,
+                        line=e.lineno or 0, symbol="<module>",
+                        message=f"file does not parse: {e.msg}")
+            local_findings.append(f)
+            files_out[rel] = {"mtime": st.st_mtime, "size": st.st_size,
+                              "summary": {"module": modname,
+                                          "path": rel, "imports": {},
+                                          "classes": {}, "functions": {},
+                                          "module_state": {},
+                                          "module_locks": [],
+                                          "thread_target_fns": [],
+                                          "traced_local": {}},
+                              "findings": [f.to_dict()]}
+            summaries[modname] = files_out[rel]["summary"]
+            continue
+        summary = summarize_module(tree, rel, modname)
+        flist: List = []
+        if run_local_passes:
+            flist.extend(jit_lint.lint_tree(tree, rel))
+            flist.extend(concurrency_lint.lint_tree(tree, rel))
+        summaries[summary["module"]] = summary
+        local_findings.extend(flist)
+        files_out[rel] = {"mtime": st.st_mtime, "size": st.st_size,
+                          "summary": summary,
+                          "findings": [f.to_dict() for f in flist]}
+
+    # skip the write on a fully-warm run — every entry came from the
+    # cache verbatim, so the merged content is what is already on disk
+    if cache_path and stats.parsed > 0:
+        try:
+            # merge, don't replace: a shared cache file serving several
+            # linted directories must keep the other directories'
+            # entries warm (stale entries for deleted files are inert —
+            # they are keyed by paths that no longer get walked)
+            merged = dict(cache["files"])
+            merged.update(files_out)
+            with open(cache_path, "w") as fh:
+                json.dump({"version": CACHE_VERSION,
+                           "files": merged}, fh)
+        except OSError:
+            pass
+
+    stats.elapsed_s = _time.perf_counter() - t0
+    return PackageIndex(summaries), local_findings, stats
+
+
+def emit_index_telemetry(stats: IndexStats) -> None:
+    """Count an index build into the process metrics registry
+    (asserted by ``scripts/check_telemetry.py`` ANALYSIS_SERIES)."""
+    from deeplearning4j_tpu import telemetry
+    telemetry.counter(
+        "lint_modules_indexed_total",
+        "modules indexed by the whole-package analysis (cache hits "
+        "included — a hit still contributes its summary)",
+    ).inc(stats.modules)
+    telemetry.histogram(
+        "lint_runtime_seconds",
+        "wall time of one whole-package index+lint run",
+        buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+    ).observe(stats.elapsed_s)

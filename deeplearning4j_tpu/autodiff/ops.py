@@ -1127,7 +1127,8 @@ def _lstm_gate_split(z, gate_order):
 
 
 def _lstm_cell_math(x, cs_prev, h_prev, w, wci, wcf, wco, b,
-                    forget_bias, cell_clip, use_peephole, gate_order):
+                    forget_bias, cell_clip, use_peephole: "Static",
+                    gate_order):
     xh = jnp.concatenate([x, h_prev], axis=1)
     i, ci, f, o = _lstm_gate_split(xh @ w + b, gate_order)
     if use_peephole:

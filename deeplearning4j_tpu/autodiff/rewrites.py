@@ -498,15 +498,45 @@ def rewrite_check_enabled() -> bool:
 
 
 def _shape_signature(sd: SameDiff):
-    """``{terminal_output: (shape, dtype)}`` via abstract evaluation,
-    or None when the graph cannot trace without real feeds (dynamic
-    control flow, unresolvable placeholder shapes) — parity checking
-    is then skipped, not failed."""
+    """``(symbolic_sig, probe_sig)`` — each ``{terminal_output:
+    (shape, dtype)}`` via abstract evaluation — or None when the graph
+    cannot trace without real feeds (dynamic control flow,
+    unresolvable placeholder shapes); parity checking is then skipped,
+    not failed.  Both modes are captured because symbolic inference
+    silently falls back to the probe: comparing a symbolic 'before'
+    against a probe-fallback 'after' would flag a correct rewrite, so
+    the parity check compares like against like (symbolic when both
+    sides are, probe otherwise)."""
     from deeplearning4j_tpu.analysis.graph_lint import infer_shapes
     try:
-        return infer_shapes(sd)
+        probe = infer_shapes(sd, symbolic=False)
     except Exception:
         return None
+    unknown = any(
+        d is None or int(d) < 0
+        for v in sd.vars.values() if v.var_type == "PLACEHOLDER"
+        for d in (v.shape or ()))
+    if not unknown:
+        return (probe, probe)    # symbolic == probe: don't trace twice
+    try:
+        sym = infer_shapes(sd)
+    except Exception:
+        sym = probe
+    return (sym, probe)
+
+
+def _is_symbolic(sig) -> bool:
+    return any(isinstance(d, str) for shape, _ in sig.values()
+               for d in shape)
+
+
+def _comparable(before, after):
+    """Pick the (before, after) signature pair in matching modes."""
+    b_sym, b_probe = before
+    a_sym, a_probe = after
+    if _is_symbolic(b_sym) == _is_symbolic(a_sym):
+        return b_sym, a_sym
+    return b_probe, a_probe
 
 
 def _run_rewrite_pass(sd: SameDiff, tag: str, fn,
@@ -532,9 +562,10 @@ def _run_rewrite_pass(sd: SameDiff, tag: str, fn,
         raise AssertionError(
             f"rewrite pass '{tag}' broke the graph: it traced before "
             "the pass but shape inference now fails")
+    before_sig, after_sig = _comparable(before, after)
     bad = []
-    for out, (shape, dtype) in before.items():
-        got = after.get(out)
+    for out, (shape, dtype) in before_sig.items():
+        got = after_sig.get(out)
         if got is None:
             bad.append(f"{out}: output disappeared")
         elif got[0] != shape:

@@ -195,7 +195,7 @@ def _fwd_kernel_single(*refs, scale: float, causal: bool,
 
 
 def _flash_fwd(q, k, v, bias, blk_q: int, blk_k: int, causal: bool,
-               scale: float, bthd: bool = False):
+               scale: float, bthd: "Static[bool]" = False):
     if bthd:
         # [b, t, h, d] viewed as [b, t, h*d] (a free bitcast): blocks
         # stay (1, blk, d) — Mosaic-legal since d % 128 == 0 — and the

@@ -205,14 +205,20 @@ class FaultInjector:
 # -- module-level site helpers (no-ops without an active injector) ------
 def active() -> Optional[FaultInjector]:
     """Innermost scoped injector, else the env-configured one."""
+    # The env-cache check-parse-rebind must stay under the lock: the
+    # decode scheduler and the watchdog both land here, and an
+    # unguarded rebind let a caller return an injector parsed from a
+    # DIFFERENT env string than the one it just compared (found by
+    # concurrency_lint CONC205 once the cross-module pass could walk
+    # GenerationServer._run -> maybe_stall -> active).
+    global _env_cache
     with _STACK_LOCK:
         if _STACK:
             return _STACK[-1]
-    global _env_cache
-    env = os.environ.get(_ENV_VAR, "")
-    if _env_cache[0] != env:
-        _env_cache = (env, FaultInjector.from_env(env))
-    return _env_cache[1]
+        env = os.environ.get(_ENV_VAR, "")
+        if _env_cache[0] != env:
+            _env_cache = (env, FaultInjector.from_env(env))
+        return _env_cache[1]
 
 
 def fires(kind: str, index: Optional[int] = None) -> bool:
@@ -254,7 +260,8 @@ def throttled_stall_plan(n_throttles: int, final: str,
              for k in range(1, n_throttles + 1)] + [final])
 
 
-def poison_slot_kv(server, slot: int, timeout_s: float = 10.0) -> bool:
+def poison_slot_kv(server: "GenerationServer", slot: int,
+                   timeout_s: float = 10.0) -> bool:
     """NaN-poison one slot's KV in a live ``GenerationServer`` —
     the deterministic stand-in for device memory corruption the
     salvage path's finiteness screen must catch.  The pool is PAGED

@@ -76,12 +76,16 @@ PAGED_KV_SERIES = [
 ]
 
 # Static-analysis subsystem series: the lint counter gets labeled
-# children from emit_analysis_series() below; sanitizer_trips_total is
-# registered by importing the training stack (its HELP/TYPE lines are
-# always on the wire; chaos_smoke additionally fires a real trip).
+# children from emit_analysis_series() below, which also runs a real
+# (small) package-index build so the whole-package-mode series carry
+# live values; sanitizer_trips_total is registered by importing the
+# training stack (its HELP/TYPE lines are always on the wire;
+# chaos_smoke additionally fires a real trip).
 ANALYSIS_SERIES = [
     'lint_findings_total{rule="JIT101",severity="error"}',
     "sanitizer_trips_total",
+    "lint_modules_indexed_total",
+    "lint_runtime_seconds_bucket",
 ]
 
 # one deliberate trace-safety violation — linting it populates
@@ -98,8 +102,12 @@ ANALYSIS_FIXTURE = (
 def emit_analysis_series(problems) -> None:
     """Lint the known-bad fixture and count the findings into the
     process registry (the CLI's --telemetry hook, in-process) — shared
-    with chaos_smoke so both reports cover the analysis subsystem."""
-    from deeplearning4j_tpu.analysis import jit_lint
+    with chaos_smoke so both reports cover the analysis subsystem.
+    Also builds a real (small) package index over the analysis
+    subpackage itself so the whole-package-mode series
+    (lint_modules_indexed_total / lint_runtime_seconds) carry live
+    values on the wire."""
+    from deeplearning4j_tpu.analysis import jit_lint, package_index
     from deeplearning4j_tpu.analysis.cli import emit_telemetry
     findings = jit_lint.lint_source(ANALYSIS_FIXTURE, "<fixture>")
     if not any(f.rule == "JIT101" for f in findings):
@@ -107,6 +115,13 @@ def emit_analysis_series(problems) -> None:
             "analysis fixture produced no JIT101 finding "
             f"(got {[f.rule for f in findings]})")
     emit_telemetry(findings)
+    pkg = os.path.join(os.path.dirname(package_index.__file__))
+    _, _, stats = package_index.build_index(pkg, root=os.path.dirname(
+        os.path.dirname(pkg)))
+    if stats.modules < 5:
+        problems.append(
+            f"package index over analysis/ saw {stats.modules} modules")
+    package_index.emit_index_telemetry(stats)
 
 
 def scrape_body(telemetry, registry) -> str:

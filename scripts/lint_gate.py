@@ -1,8 +1,10 @@
 #!/usr/bin/env python
-"""Static-analysis CI gate.
+"""Static-analysis CI gate — whole-package, cross-module, cached.
 
-Runs ``deeplearning4j_tpu.analysis`` over the package, diffs the
-findings against the checked-in ``ANALYSIS_BASELINE.json``, and:
+Runs ``deeplearning4j_tpu.analysis`` over the package in whole-package
+mode (per-module rules PLUS the cross-module JIT106/CONC205/CONC206
+passes over the package index), diffs the findings against the
+checked-in ``ANALYSIS_BASELINE.json``, and:
 
 * exits 0 when every finding is covered by the baseline (stale keys —
   fixed debt — are reported but do not fail);
@@ -10,11 +12,22 @@ findings against the checked-in ``ANALYSIS_BASELINE.json``, and:
   (``+`` new finding, ``-`` stale baseline key);
 * ``--update-baseline`` rewrites the baseline to match the current
   findings (preserving the justifications of surviving keys — fill in
-  a justification for every new entry before committing!) and exits 0.
+  a justification for every new entry before committing!) and exits 0;
+* ``--changed-only`` gates only on new findings in files the working
+  tree changed vs ``--diff-base`` (default HEAD).  The whole package
+  is still indexed — a change in module A can create a finding in
+  module B, and the per-file-mtime cache keeps the full run at
+  sub-second warm — but the verdict is scoped to the diff, for
+  fast pre-commit loops.  Off-diff new findings are reported as a
+  note, not a failure;
+* ``--audit-baseline`` audits the debt ledger: stale keys (fixed debt
+  still listed) and entries with no justification fail the audit.
 
 Wired alongside ``check_telemetry.py`` / ``chaos_smoke.py``:
 
     JAX_PLATFORMS=cpu python scripts/lint_gate.py
+    JAX_PLATFORMS=cpu python scripts/lint_gate.py --changed-only
+    JAX_PLATFORMS=cpu python scripts/lint_gate.py --audit-baseline
     JAX_PLATFORMS=cpu python scripts/lint_gate.py --update-baseline
 
 The lint is pure AST walking — nothing in the linted tree is imported
@@ -23,6 +36,7 @@ trees (a file that does not parse is itself a finding).
 """
 import argparse
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -31,24 +45,73 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = os.path.join(REPO, "ANALYSIS_BASELINE.json")
 DEFAULT_PATHS = [os.path.join(REPO, "deeplearning4j_tpu")]
+DEFAULT_CACHE = os.path.join(REPO, ".dl4j_lint_cache.json")
+
+
+def changed_files(diff_base: str):
+    """Repo-relative paths the working tree changed vs ``diff_base``
+    (tracked modifications + untracked .py files)."""
+    out = set()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", diff_base, "--"],
+            cwd=REPO, capture_output=True, text=True, check=True)
+        out.update(line.strip() for line in diff.stdout.splitlines()
+                   if line.strip())
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=REPO, capture_output=True, text=True, check=True)
+        out.update(line.strip() for line in untracked.stdout.splitlines()
+                   if line.strip())
+    except (OSError, subprocess.CalledProcessError) as e:
+        raise SystemExit(f"--changed-only needs a git tree: {e}")
+    return out
 
 
 def main(argv=None) -> int:
-    from deeplearning4j_tpu.analysis.cli import emit_telemetry, lint_paths
+    from deeplearning4j_tpu.analysis.cli import (_merge_stats,
+                                                 emit_telemetry,
+                                                 lint_package,
+                                                 lint_paths)
     from deeplearning4j_tpu.analysis.findings import Baseline
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=None)
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--audit-baseline", action="store_true",
+                    help="report stale / unjustified baseline keys; "
+                         "exit 1 when any exist")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="gate only on new findings in files changed "
+                         "vs --diff-base (full package still indexed)")
+    ap.add_argument("--diff-base", default="HEAD")
+    ap.add_argument("--cache", default=DEFAULT_CACHE)
+    ap.add_argument("--no-cache", action="store_true")
+    ap.add_argument("--no-cross", action="store_true",
+                    help="per-module rules only (PR 4 behavior)")
     ap.add_argument("--telemetry", action="store_true",
                     help="count findings into the metrics registry")
     args = ap.parse_args(argv)
 
     paths = args.paths or DEFAULT_PATHS
-    findings = lint_paths(paths, root=REPO)
+    findings, stats = [], None
+    for p in paths:
+        if os.path.isdir(p):
+            fs, st = lint_package(
+                p, root=REPO,
+                cache_path=None if args.no_cache else args.cache,
+                cross=not args.no_cross)
+            findings.extend(fs)
+            stats = _merge_stats(stats, st)
+        else:
+            findings.extend(lint_paths([p], root=REPO))
     if args.telemetry:
         emit_telemetry(findings)
+        if stats is not None:
+            from deeplearning4j_tpu.analysis.package_index import (
+                emit_index_telemetry)
+            emit_index_telemetry(stats)
 
     if args.update_baseline:
         old = Baseline.load(args.baseline) if \
@@ -74,13 +137,43 @@ def main(argv=None) -> int:
         baseline = Baseline.load(args.baseline)
     new, baselined, stale = baseline.diff(findings)
 
+    if args.audit_baseline:
+        unjustified = sorted(k for k, v in baseline.entries.items()
+                             if not v["justification"])
+        for k in stale:
+            print(f"- [stale: no longer produced] {k}")
+        for k in unjustified:
+            print(f"? [no justification] {k}")
+        print(f"== baseline audit: {len(baseline.entries)} key(s), "
+              f"{len(stale)} stale, {len(unjustified)} unjustified")
+        if stale or unjustified:
+            print("FAIL: prune stale keys with --update-baseline and "
+                  "justify every accepted finding")
+            return 1
+        print("OK")
+        return 0
+
+    scope_note = ""
+    if args.changed_only:
+        changed = changed_files(args.diff_base)
+        off_diff = [f for f in new if f.path not in changed]
+        new = [f for f in new if f.path in changed]
+        if off_diff:
+            scope_note = (f"note: {len(off_diff)} new finding(s) "
+                          "OUTSIDE the diff (run the full gate): " +
+                          ", ".join(sorted({f.path for f in off_diff})))
+
     for f in new:
         print(f"+ {f.render()}")
     for k in stale:
         print(f"- [stale baseline key] {k}")
+    idx = (f", {stats.modules} modules indexed "
+           f"({stats.cache_hits} cached)" if stats else "")
     print(f"== lint gate: {len(findings)} finding(s), "
           f"{len(baselined)} baselined, {len(new)} NEW, "
-          f"{len(stale)} stale")
+          f"{len(stale)} stale{idx}")
+    if scope_note:
+        print(scope_note)
     if new:
         print("FAIL: new findings — fix them, or (with a written "
               "justification) add them via --update-baseline")

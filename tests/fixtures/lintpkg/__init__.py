@@ -1,0 +1,11 @@
+"""Cross-module lint fixture package (NEVER imported — pure AST food
+for tests/test_analysis.py).  Each module pair exercises one
+whole-package rule with a positive and a negative case:
+
+* ``jit_entry`` + ``helpers`` — JIT106 (trace context reaching a
+  host-impure / mutating callee across the module boundary);
+* ``conc_spawn`` + ``conc_state`` — CONC205 (cross-module thread
+  target writing module-level state with/without the lock);
+* ``poker`` + ``owner`` — CONC206 (annotation-typed foreign object's
+  lock-guarded attributes poked with/without its lock).
+"""

@@ -1,0 +1,17 @@
+"""Thread spawner in a DIFFERENT module than the state it reaches —
+the cross-module thread target CONC205 needs."""
+import threading
+
+from lintpkg import conc_state
+
+
+def worker():
+    conc_state.guarded_write("k", 1)
+    conc_state.unguarded_write("k", 2)
+    conc_state.rebind_flag(True)
+
+
+def start():
+    t = threading.Thread(target=worker)
+    t.start()
+    return t

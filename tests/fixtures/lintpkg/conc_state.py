@@ -1,0 +1,26 @@
+"""Module-level state for the CONC205 fixtures.  The thread that
+reaches it is spawned in conc_spawn.py — a different module, so the
+per-class pass can never see the race."""
+import threading
+
+_LOCK = threading.Lock()
+_CACHE = {}
+_PLAIN = None
+
+
+def guarded_write(key, value):
+    with _LOCK:
+        _CACHE[key] = value      # provably locked: clean
+
+
+def unguarded_write(key, value):
+    _CACHE[key] = value          # CONC205: thread-reachable, no lock
+
+
+def rebind_flag(value):
+    global _PLAIN
+    _PLAIN = value               # CONC205: global rebind, no lock
+
+
+def untouched_write(key, value):
+    _CACHE[key] = value          # no thread ever reaches this: clean

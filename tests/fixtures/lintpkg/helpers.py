@@ -1,0 +1,24 @@
+"""Cross-module callees for the JIT106 fixtures."""
+import time
+
+
+def impure_helper(x):
+    t = time.time()            # JIT106 error when reached from a trace
+    return x * t
+
+
+def clean_helper(x):
+    return x + 1
+
+
+def chain_helper(x):
+    return impure_helper(x)    # one more hop down the call graph
+
+
+class Stateful:
+    def __init__(self):
+        self.cache = None
+
+    def mutating_step(self, x):
+        self.cache = x         # JIT106 warning when trace-reached
+        return x

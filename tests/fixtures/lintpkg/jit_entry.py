@@ -1,0 +1,31 @@
+"""Trace contexts whose callees live in helpers.py — invisible to the
+per-module pass, flagged by jit_lint.lint_package (JIT106)."""
+import jax
+
+from lintpkg import helpers
+from lintpkg.helpers import Stateful, chain_helper, clean_helper
+
+
+@jax.jit
+def entry_direct(x):
+    return helpers.impure_helper(x)     # cross-module host impurity
+
+
+@jax.jit
+def entry_chain(x):
+    return chain_helper(x)              # two hops to the impurity
+
+
+@jax.jit
+def entry_clean(x):
+    return clean_helper(x)              # clean callee: no finding
+
+
+def build_tick(s: "Stateful"):
+    def tick(x):
+        return s.mutating_step(clean_helper(x))
+    return jax.jit(tick)
+
+
+def host_side(x):
+    return helpers.impure_helper(x)     # not a trace context: clean

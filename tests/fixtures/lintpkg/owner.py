@@ -1,0 +1,19 @@
+"""Lock-owning class whose guarded attributes get poked from poker.py
+(the non-owning module)."""
+import threading
+
+
+class Owner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+        self._count = 0
+
+    def put(self, k, v):
+        with self._lock:
+            self._table[k] = v
+            self._count += 1
+
+    def total(self):
+        with self._lock:
+            return self._count

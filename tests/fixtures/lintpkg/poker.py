@@ -1,0 +1,28 @@
+"""Accesses Owner's lock-guarded attributes from a non-owning module
+(CONC206): the annotation ``o: "Owner"`` / the constructor assignment
+is what types the object for the cross-module pass."""
+from lintpkg.owner import Owner
+
+
+def polite_poke(o: "Owner", v):
+    with o._lock:
+        o._count = v             # under the owner's lock: clean
+
+
+def rude_poke(o: "Owner", v):
+    o._count = v                 # CONC206 error: guarded store, no lock
+
+
+def rude_peek(o: "Owner"):
+    return o._count              # CONC206 warning: guarded load
+
+
+def constructor_typed():
+    o = Owner()
+    o._table["k"] = 1            # CONC206 error via constructor typing
+    return o
+
+
+def api_use(o: "Owner"):
+    o.put("k", 2)                # method call: supported API, clean
+    return o.total()
