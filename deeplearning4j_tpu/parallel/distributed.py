@@ -21,6 +21,7 @@ dense all-reduce over ICI is faster than any encode/decode round-trip.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -143,7 +144,13 @@ def _control_mesh(mesh: Optional[Mesh] = None) -> Mesh:
 # (reduce_fn, device ids) -> (jitted reducer, input sharding, local
 # device count).  The preemption poll runs once per training step:
 # rebuilding the mesh and re-jitting there would put a retrace on
-# every step boundary.
+# every step boundary.  Writes are guarded by _CONTROL_LOCK: the poll
+# also runs off trainer/watchdog threads (e.g. a server-side health
+# loop piggybacking or_reduce_flag), and an unguarded dict write from
+# two first-callers could interleave with the read — this was the
+# whole-package linter's "unproven rather than proven-safe" blind
+# spot (ROADMAP item 5); now it is simply safe.
+_CONTROL_LOCK = threading.Lock()
 _CONTROL_CACHE: dict = {}
 
 
@@ -151,15 +158,20 @@ def _reduce_scalar(reduce_fn, value: int,
                    mesh: Optional[Mesh] = None) -> int:
     key = (reduce_fn, None if mesh is None
            else tuple(d.id for d in mesh.devices.flat))
-    cached = _CONTROL_CACHE.get(key)
-    if cached is None:
-        cmesh = _control_mesh(mesh)
-        cached = (jax.jit(reduce_fn,
-                          out_shardings=NamedSharding(cmesh, P())),
-                  NamedSharding(cmesh, P("fleet")),
-                  sum(d.process_index == jax.process_index()
-                      for d in cmesh.devices.flat))
-        _CONTROL_CACHE[key] = cached
+    with _CONTROL_LOCK:
+        cached = _CONTROL_CACHE.get(key)
+        if cached is None:
+            # built under the lock: jax.jit() here only wraps (no
+            # trace happens until the call below), so the critical
+            # section stays host-cheap and two racing first-callers
+            # cannot publish torn (reducer, sharding) pairs
+            cmesh = _control_mesh(mesh)
+            cached = (jax.jit(reduce_fn,
+                              out_shardings=NamedSharding(cmesh, P())),
+                      NamedSharding(cmesh, P("fleet")),
+                      sum(d.process_index == jax.process_index()
+                          for d in cmesh.devices.flat))
+            _CONTROL_CACHE[key] = cached
     jitted, sharding, mine = cached
     local = np.full((mine,), int(value), np.int32)
     if jax.process_count() == 1:

@@ -484,6 +484,13 @@ class GenerationServer:
                                          # dispatch is in flight
         self._shutdown = False
         self._drain = False
+        self._admission_closed = False   # drain(): submits raise, the
+                                         # scheduler keeps running
+        # per-INSTANCE prefix-cache tallies beside the process-global
+        # counters: a router comparing replicas' cache warmth needs
+        # the split (the global series aggregates every replica)
+        self._n_prefix_hits = 0
+        self._n_prefix_misses = 0
         self._stop_event = threading.Event()   # ends the watchdog
         # retire prior DEAD servers' series before adding ours: the
         # last-known 0 stays scrapeable until the next construction,
@@ -578,6 +585,77 @@ class GenerationServer:
         open (the ``server_healthy`` gauge, as a method)."""
         with self._lock:
             return (not self._shutdown and self._worker.is_alive())
+
+    def stats(self) -> dict:
+        """ONE lock-consistent snapshot of the serving state an
+        admission router dispatches on (every field read under the
+        same lock acquisition — a torn multi-call view could admit
+        against blocks a concurrent retire already freed):
+
+        ``healthy`` (scheduler alive, admission open), ``draining``
+        (:meth:`drain` called — or shutdown), ``n_slots`` /
+        ``live_slots`` / ``free_slots``, ``queue_depth`` (submitted,
+        not yet in a slot), ``block_size`` / ``kv_blocks`` /
+        ``free_blocks`` (free list + evictable cache entries — the
+        admission headroom a least-loaded placement ranks on),
+        ``cached_blocks`` (resident prefix-cache entries), and
+        ``prefix_hits`` / ``prefix_misses`` — THIS instance's
+        admissions (the process-global ``prefix_cache_*_total``
+        counters aggregate every replica in the process, so a router
+        proving one replica's cache is warm needs the per-instance
+        split)."""
+        with self._lock:
+            return {
+                "healthy": (not self._shutdown
+                            and self._worker.is_alive()),
+                "draining": self._admission_closed or self._shutdown,
+                "n_slots": self.n_slots,
+                "live_slots": len(self._active),
+                "free_slots": len(self._free),
+                "queue_depth": len(self._pending) + self._queue.qsize(),
+                "block_size": self.block_size,
+                "kv_blocks": self.kv_blocks,
+                "free_blocks": (len(self._blocks_free)
+                                + len(self._evictable)),
+                "cached_blocks": len(self._block_hash),
+                "prefix_hits": self._n_prefix_hits,
+                "prefix_misses": self._n_prefix_misses,
+            }
+
+    def prefix_warmth(self, prompt_ids) -> int:
+        """Membership probe for prefix-affinity routing: how many of
+        the prompt's leading FULL blocks are resident in THIS server's
+        prefix cache right now (bytes-verified, nothing mutated, no
+        refcount taken — the answer is advisory and may be stale by
+        the time the request lands, which only costs a suffix prefill,
+        never correctness).  0 when the cache is disabled, the prompt
+        is shorter than one full block, or nothing matches."""
+        if not self.prefix_cache:
+            return 0
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            return 0
+        hashes = self._chain_hashes(prompt)   # pure — outside the lock
+        n = 0
+        with self._lock:
+            for hsh, tok in hashes:
+                entry = self._prefix_map.get(hsh)
+                if entry is None or entry[1] != tok:
+                    break
+                n += 1
+        return n
+
+    def drain(self) -> None:
+        """Close admission WITHOUT stopping the server: subsequent
+        ``submit*`` calls raise ``RuntimeError``, everything already
+        queued or in flight runs to completion, and the scheduler —
+        with its telemetry, :meth:`stats` and the watchdog — stays
+        alive.  The router-side building block for rolling a replica
+        out of a fleet; ``shutdown(drain=True)`` is the terminal
+        variant that also stops the scheduler.  One-way: construct a
+        fresh server to reopen admission."""
+        with self._lock:
+            self._admission_closed = True
 
     def _resolve_sampling(self, sampling, seed):
         """Merge a per-request ``sampling`` dict over the server-wide
@@ -736,6 +814,10 @@ class GenerationServer:
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("GenerationServer has been shut down")
+            if self._admission_closed:
+                raise RuntimeError(
+                    "GenerationServer is draining (admission closed; "
+                    "in-flight work continues)")
         prompt = np.asarray(prompt_ids, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
             raise ValueError("prompt_ids must be a non-empty 1-D int "
@@ -1121,6 +1203,10 @@ class GenerationServer:
             self._ids[slot, :req.t0] = req.prompt
             if self.prefix_cache:
                 self._register_prefix_locked(plan)
+            if matched:
+                self._n_prefix_hits += 1
+            else:
+                self._n_prefix_misses += 1
         _ADMITTED.inc()
         if matched:
             _PREFIX_HITS.inc()

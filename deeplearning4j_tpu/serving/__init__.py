@@ -1,0 +1,45 @@
+"""Multi-tenant serving fleet: admission routing over decode replicas.
+
+The scale-out layer above ``parallel.GenerationServer`` (ROADMAP item
+2): a thin scheduling/placement frontend (:class:`ServingFleet`) over
+N homogeneous decode-server replicas — the TensorFlow-paper
+frontend/worker split, with the resilience and observability the
+TPU-fleet retrospective says must be designed in:
+
+* per-tenant **quotas** (token buckets + concurrency/queue caps —
+  :mod:`~.tenancy`), so one hot tenant cannot starve the fleet;
+* **SLO-aware dispatch**: priority classes + earliest-deadline-first,
+  with infeasible deadlines rejected at admission
+  (:class:`~.errors.DeadlineInfeasibleError`) instead of burning KV
+  blocks;
+* **prefix-affinity placement** (:mod:`~.placement`): same-prefix
+  requests route to the replica whose prefix cache is warm,
+  least-loaded-by-free-blocks otherwise;
+* **lifecycle**: health-weighted dispatch, ``drain()`` for rolling
+  restarts, and live migration — a dead or hard-drained replica's
+  queued and in-flight requests re-place onto survivors and complete
+  byte-identical to offline ``generate()``.
+
+Telemetry rides the PR-1 registry: ``fleet_requests_total{tenant=,
+outcome=}``, ``fleet_replica_dispatch_total{replica=,reason=}``,
+``fleet_queue_wait_seconds{tenant=}``, ``fleet_replicas_healthy``.
+"""
+from deeplearning4j_tpu.serving.errors import (DeadlineInfeasibleError,
+                                               FleetAdmissionError,
+                                               NoHealthyReplicaError,
+                                               QuotaExceededError)
+from deeplearning4j_tpu.serving.placement import (AFFINITY, FAILOVER,
+                                                  LEAST_LOADED,
+                                                  choose_replica,
+                                                  replica_view)
+from deeplearning4j_tpu.serving.router import ServingFleet
+from deeplearning4j_tpu.serving.tenancy import (TenantAccountant,
+                                                TenantQuota)
+
+__all__ = [
+    "ServingFleet", "TenantQuota", "TenantAccountant",
+    "FleetAdmissionError", "QuotaExceededError",
+    "DeadlineInfeasibleError", "NoHealthyReplicaError",
+    "choose_replica", "replica_view",
+    "AFFINITY", "LEAST_LOADED", "FAILOVER",
+]

@@ -1,0 +1,916 @@
+"""ServingFleet: an admission router over N decode-server replicas.
+
+PR 2–7 built a single-replica continuous-batching decode server
+(paged KV, shared-prefix reuse, self-healing).  This module is the
+thin scheduling/placement frontend that turns N of those into a
+SERVING SYSTEM — the TensorFlow-paper split of a small stateful
+scheduler over homogeneous compute workers, applied one level up from
+the slot scheduler inside each ``GenerationServer``:
+
+* **admission** — every ``submit(tenant=...)`` passes the per-tenant
+  token buckets + concurrency/queue caps in
+  :class:`~.tenancy.TenantAccountant` before it can touch a replica.
+  A hot tenant saturating its bucket WAITS; a structurally-
+  unadmittable request (cost above burst, queue cap hit) fails fast
+  with :class:`~.errors.QuotaExceededError`; and because the dispatch
+  pass walks ALL waiting requests each pass (not FIFO across
+  tenants), a capped hot tenant cannot delay a cold tenant's
+  admission beyond one scheduling pass;
+* **SLO-aware dispatch** — waiting requests dispatch in
+  (priority, earliest deadline, arrival) order — EDF within a
+  priority class, reusing PR 3's per-request ``deadline_s`` plumbing
+  end to end (the remaining budget rides into the replica, which
+  enforces expiry mid-decode).  Requests whose deadline cannot be met
+  even dispatched immediately (``est_token_s * n_new`` above the
+  budget, or a non-positive budget) are rejected at submit with
+  :class:`~.errors.DeadlineInfeasibleError` — no KV blocks burned on
+  a request that must expire;
+* **placement** — prefix-affinity first (route same-prefix requests
+  to the replica whose cache is warm, via the bytes-verified
+  ``prefix_warmth`` probe PR 7's chain hashes enable), least-loaded
+  by free KV blocks otherwise (:mod:`~.placement`); unhealthy and
+  draining replicas are never candidates (health-weighted dispatch
+  off the same liveness the ``server_healthy`` gauge exposes);
+* **lifecycle** — :meth:`ServingFleet.drain` rolls a replica out
+  (admission stops, in-flight finishes; ``hard=True`` also migrates
+  its work), :meth:`ServingFleet.kill` is the chaos-drill
+  SIGKILL-equivalent, and LIVE MIGRATION closes ROADMAP item 4's
+  remainder: when a replica dies or is drained hard, its queued AND
+  in-flight requests re-place onto surviving replicas through the
+  existing retry machinery (typed retryable errors +
+  ``resilience.retry.backoff_delay`` jitter, bounded by
+  ``migration_retries``) and complete byte-identical to offline
+  ``generate()`` — greedy decode is deterministic, so a from-scratch
+  re-decode on the survivor IS the same bytes.
+
+The fleet is in-process: replicas share the host and its device(s),
+which is the single-chip degenerate of the multi-host layout (each
+replica maps to one chip/pod-slice worker; the router's state is
+host-side dicts either way).  The mesh-sharded tick is the ROADMAP
+remainder this PR does not touch.
+
+Telemetry: ``fleet_requests_total{tenant=,outcome=}`` (admitted /
+queued / rejected_quota / rejected_deadline / migrated — plus
+terminal cancelled / expired / failed), ``fleet_replica_dispatch_
+total{replica=,reason=}`` (affinity / least_loaded / failover),
+``fleet_queue_wait_seconds{tenant=}``, ``fleet_replicas_healthy`` and
+``fleet_queue_depth``.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.parallel.generation_server import GenerationServer
+from deeplearning4j_tpu.resilience.errors import (CancelledError,
+                                                  DeadlineExceededError,
+                                                  RetryableServerError)
+from deeplearning4j_tpu.resilience.retry import backoff_delay, retry_call
+from deeplearning4j_tpu.serving.errors import (DeadlineInfeasibleError,
+                                               NoHealthyReplicaError,
+                                               QuotaExceededError)
+from deeplearning4j_tpu.serving.placement import FAILOVER, choose_replica
+from deeplearning4j_tpu.serving.tenancy import TenantAccountant, TenantQuota
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_INF = float("inf")
+
+_REQS = telemetry.counter(
+    "fleet_requests_total",
+    "fleet admission outcomes per tenant: admitted (dispatched to a "
+    "replica), queued (waited >= 1 pass on quota/capacity), "
+    "rejected_quota, rejected_deadline (infeasible SLO), migrated "
+    "(re-placed off a dead/drained replica), cancelled, expired, "
+    "failed", labelnames=("tenant", "outcome"))
+_DISPATCH = telemetry.counter(
+    "fleet_replica_dispatch_total",
+    "requests dispatched per replica by placement reason: affinity "
+    "(prefix-cache warm), least_loaded (most free KV blocks), "
+    "failover (migration off a dead/drained replica)",
+    labelnames=("replica", "reason"))
+_QWAIT = telemetry.histogram(
+    "fleet_queue_wait_seconds",
+    "submit -> first dispatch per request, by tenant (the admission "
+    "delay quotas and capacity impose — the fairness signal)",
+    labelnames=("tenant",))
+_REPL_HEALTHY = telemetry.gauge(
+    "fleet_replicas_healthy",
+    "replicas currently dispatchable (healthy, not dead, not "
+    "draining) — a fleet balancer's aggregate health signal")
+_FLEET_QDEPTH = telemetry.gauge(
+    "fleet_queue_depth",
+    "requests waiting in the fleet router (intake + quota/capacity "
+    "wait line; per-replica queues are counted by the replicas)")
+
+#: intake sentinel that wakes the scheduler without meaning "stop"
+_WAKE = object()
+
+
+class _FleetRequest:
+    """One request riding through the fleet.  ``result()`` blocks the
+    caller; the fleet scheduler fills ``_result``/``_error``.  The
+    handle survives migration: ``inner``/``replica`` point at the
+    CURRENT placement and are rewritten when the request re-places off
+    a dead replica."""
+
+    __slots__ = ("prompt", "n_new", "eos_id", "seed", "sampling",
+                 "tenant", "priority", "cost", "deadline", "t_submit",
+                 "t_submit_m", "cancelled", "migrations", "replica",
+                 "inner", "ttft", "_t_dispatch", "_not_before",
+                 "_migrate", "_quota_held", "_queued_counted",
+                 "_migrating", "_result", "_error", "_event")
+
+    def __init__(self, prompt, n_new, eos_id, seed, sampling, tenant,
+                 priority, cost, deadline):
+        self.prompt = prompt
+        self.n_new = n_new
+        self.eos_id = eos_id
+        self.seed = seed
+        self.sampling = sampling
+        self.tenant = tenant
+        self.priority = priority
+        self.cost = cost
+        self.deadline = deadline      # absolute time.monotonic() or None
+        self.t_submit = time.perf_counter()
+        self.t_submit_m = time.monotonic()
+        self.cancelled = False
+        self.migrations = 0
+        self.replica: Optional[int] = None
+        self.inner = None             # the replica-side handle
+        self.ttft = None              # submit -> first token of the
+                                      # SUCCESSFUL attempt (queue wait
+                                      # + any migration included)
+        self._t_dispatch = None
+        self._not_before = 0.0        # migration backoff gate
+        self._migrate = False         # replica died / hard-drained
+        self._quota_held = False      # bucket charged + concurrency
+                                      # slot taken (kept across
+                                      # migrations — one request, one
+                                      # charge)
+        self._queued_counted = False
+        self._migrating = False       # next dispatch is a failover
+        self._result = None
+        self._error = None
+        self._event = threading.Event()
+
+    @property
+    def emitted(self) -> int:
+        """Tokens emitted by the CURRENT placement (0 while waiting)."""
+        inner = self.inner
+        return inner.emitted if inner is not None else 0
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until the request retires fleet-side; returns the
+        full sequence (prompt + generated).  A ``TimeoutError`` leaves
+        the request LIVE — ``cancel()`` releases it."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"fleet result not ready within {timeout}s (the "
+                "request is still live; cancel() releases it)")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def cancel(self) -> bool:
+        """Best-effort cancellation (queue entry or in-flight slot is
+        released at the next scheduling pass).  False when already
+        completed."""
+        if self._event.is_set():
+            return False
+        self.cancelled = True
+        inner = self.inner
+        if inner is not None:
+            inner.cancel()
+        return True
+
+
+class ServingFleet:
+    """Admission router + lifecycle manager over ``n_replicas``
+    in-process :class:`GenerationServer` replicas.
+
+    >>> fleet = ServingFleet(net, n_replicas=2, n_slots=8,
+    ...                      quotas={"free": TenantQuota(
+    ...                          tokens_per_s=500, max_concurrent=2)})
+    >>> out = fleet.submit(ids, n_new=64, tenant="free")  # blocking
+    >>> h = fleet.submit_async(ids, n_new=64, deadline_s=2.0,
+    ...                        priority=1)
+    >>> out = h.result(); h.replica; h.migrations
+    >>> fleet.drain(0); fleet.stats(); fleet.shutdown(drain=True)
+
+    ``quotas`` maps tenant name -> :class:`TenantQuota`
+    (``default_quota`` covers everyone else; the no-argument default
+    is unlimited).  ``est_token_s`` is the per-token decode-time floor
+    the deadline-feasibility screen uses (None disables the screen
+    beyond "deadline already spent").  ``migration_retries`` bounds
+    how many times one request may re-place off dying replicas before
+    its last failure propagates; re-placements back off with the
+    resilience layer's full-jitter ``backoff_delay``.  Remaining
+    ``**server_kwargs`` construct the replicas (``n_slots``,
+    ``block_size``, ``tick_batch``, ...)."""
+
+    def __init__(self, net, n_replicas: int = 2,
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 default_quota: Optional[TenantQuota] = None,
+                 est_token_s: Optional[float] = None,
+                 migration_retries: int = 3,
+                 retry_backoff_s: float = 0.02,
+                 poll_interval_s: float = 0.002,
+                 dead_after_s: float = 1.0,
+                 queue_limit: int = 4096,
+                 **server_kwargs):
+        self.n_replicas = int(n_replicas)
+        if self.n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.est_token_s = (float(est_token_s)
+                            if est_token_s is not None else None)
+        self.migration_retries = int(migration_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.dead_after_s = float(dead_after_s)
+        self._servers = tuple(GenerationServer(net, **server_kwargs)
+                              for _ in range(self.n_replicas))
+        self._acct = TenantAccountant(default_quota, quotas)
+        # fleet scheduler state: everything below mutates ONLY under
+        # _lock (the GenerationServer discipline, one level up)
+        self._lock = threading.RLock()
+        self._intake: "queue.Queue" = queue.Queue(maxsize=int(queue_limit))
+        self._waiting: List[_FleetRequest] = []
+        self._inflight: List[_FleetRequest] = []
+        self._dead = set()
+        self._draining = set()
+        self._unhealthy_since: Dict[int, float] = {}
+        self._shutdown = False
+        self._drain_mode = False
+        _REPL_HEALTHY.set(self.n_replicas)
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # -- public API ----------------------------------------------------
+    def submit_async(self, prompt_ids, n_new: int, tenant: str = "default",
+                     eos_id: Optional[int] = None, seed: int = 0,
+                     priority: int = 0,
+                     deadline_s: Optional[float] = None,
+                     sampling: Optional[dict] = None) -> _FleetRequest:
+        """Enqueue one request under ``tenant``'s quota; returns a
+        handle whose ``result()`` blocks.  ``priority`` orders
+        dispatch (lower = sooner); within a priority class requests
+        dispatch earliest-deadline-first.  ``deadline_s`` bounds total
+        residence (fleet queue wait included) and is feasibility-
+        screened HERE — an unmeetable deadline raises
+        :class:`DeadlineInfeasibleError` before any replica state is
+        touched.  Structurally-unadmittable quota violations raise
+        :class:`QuotaExceededError` the same way."""
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("ServingFleet has been shut down")
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError("prompt_ids must be a non-empty 1-D int "
+                             f"array, got shape {prompt.shape}")
+        n_new = int(n_new)
+        if n_new < 1:
+            raise ValueError("n_new must be >= 1")
+        max_len = self._servers[0].max_len
+        if len(prompt) + n_new > max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + n_new ({n_new}) exceeds the "
+                f"replica cache length ({max_len})")
+        tenant = str(tenant)
+        cost = float(len(prompt) + n_new)
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+            floor = (self.est_token_s or 0.0) * n_new
+            if deadline_s <= 0 or floor > deadline_s:
+                _REQS.labels(tenant=tenant,
+                             outcome="rejected_deadline").inc()
+                raise DeadlineInfeasibleError(
+                    f"deadline_s={deadline_s:g} cannot be met: the "
+                    f"decode-time floor for n_new={n_new} is "
+                    f"{floor:g}s (est_token_s="
+                    f"{self.est_token_s}) — rejected before burning "
+                    "blocks")
+        reason = self._acct.reserve_queued(tenant, cost)
+        if reason is not None:
+            _REQS.labels(tenant=tenant, outcome="rejected_quota").inc()
+            raise QuotaExceededError(reason)
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        req = _FleetRequest(prompt, n_new,
+                            None if eos_id is None else int(eos_id),
+                            int(seed), sampling, tenant, int(priority),
+                            cost, deadline)
+        while True:
+            try:
+                self._intake.put(req, timeout=0.1)
+                break
+            except queue.Full:
+                with self._lock:
+                    down = self._shutdown
+                if down:
+                    self._acct.drop_queued(tenant)
+                    raise RuntimeError(
+                        "ServingFleet has been shut down") from None
+        with self._lock:
+            dead = self._shutdown and not self._worker.is_alive()
+        if dead:
+            # raced shutdown(): the put may have landed after the
+            # scheduler's final drain — fail leftovers ourselves
+            self._fail_leftovers()
+        return req
+
+    def submit(self, prompt_ids, n_new: int, tenant: str = "default",
+               eos_id: Optional[int] = None, seed: int = 0,
+               priority: int = 0, timeout: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               sampling: Optional[dict] = None,
+               retries: int = 0) -> np.ndarray:
+        """Blocking ``submit_async().result()``.  ``retries``
+        re-submits after a ``RetryableServerError`` (e.g. the whole
+        fleet was momentarily unhealthy) through the existing
+        ``retry_call`` machinery with full-jitter backoff."""
+
+        def attempt():
+            return self.submit_async(
+                prompt_ids, n_new, tenant=tenant, eos_id=eos_id,
+                seed=seed, priority=priority, deadline_s=deadline_s,
+                sampling=sampling).result(timeout)
+
+        if retries <= 0:
+            return attempt()
+        return retry_call(attempt, retries=int(retries),
+                          base_delay=self.retry_backoff_s,
+                          op="serving_fleet.submit")
+
+    def drain(self, replica: int, hard: bool = False) -> None:
+        """Roll ``replica`` out of dispatch: admission to it stops
+        (placement never picks a draining replica) and its own
+        admission closes (``GenerationServer.drain``).  Default: work
+        already on it finishes there.  ``hard=True`` additionally
+        MIGRATES its queued and in-flight requests to surviving
+        replicas (each completes byte-identical to offline
+        ``generate()`` — greedy decode is deterministic, so the
+        survivor's from-scratch decode is the same bytes)."""
+        idx = self._check_replica(replica)
+        with self._lock:
+            self._draining.add(idx)
+        self._servers[idx].drain()
+        if hard:
+            self._mark_migrate(idx)
+        self._wake()
+
+    def kill(self, replica: int, timeout: float = 10.0) -> None:
+        """SIGKILL-equivalent replica death (chaos drills and tests):
+        the replica is marked dead, hard-stopped, and every request
+        that was queued on or in flight at it migrates to surviving
+        replicas and completes byte-identical to offline
+        ``generate()``."""
+        idx = self._check_replica(replica)
+        with self._lock:
+            already = idx in self._dead
+            self._dead.add(idx)
+        self._mark_migrate(idx)
+        if not already:
+            # hard stop: in-flight handles fail immediately (the
+            # migration trigger); no graceful drain, like a real kill
+            self._servers[idx].shutdown(drain=False, timeout=timeout)
+        self._wake()
+
+    def stats(self) -> dict:
+        """Fleet snapshot: per-replica ``GenerationServer.stats()``
+        (plus fleet-side ``dead``/``draining`` flags), wait-line and
+        in-flight depths, dispatchable-replica count, and the
+        per-tenant accounting view."""
+        with self._lock:
+            dead = set(self._dead)
+            draining = set(self._draining)
+            waiting = len(self._waiting)
+            inflight = len(self._inflight)
+        replicas = []
+        for i, srv in enumerate(self._servers):
+            st = srv.stats()
+            st["dead"] = i in dead
+            st["draining"] = bool(st["draining"]) or i in draining
+            replicas.append(st)
+        healthy = sum(1 for st in replicas
+                      if st["healthy"] and not st["dead"]
+                      and not st["draining"])
+        return {"replicas": replicas, "waiting": waiting,
+                "inflight": inflight, "healthy_replicas": healthy,
+                "tenants": self._acct.snapshot()}
+
+    def replica(self, idx: int) -> GenerationServer:
+        """The underlying replica (tests / advanced introspection)."""
+        return self._servers[self._check_replica(idx)]
+
+    def shutdown(self, drain: bool = False, timeout: float = 30.0):
+        """Stop the fleet.  Default: waiting and in-flight requests
+        fail with RuntimeError.  ``drain=True``: admission closes but
+        everything already submitted runs to completion (including
+        any pending migrations) before the scheduler and the replicas
+        exit."""
+        with self._lock:
+            self._drain_mode = bool(drain)
+            self._shutdown = True
+            worker = self._worker
+        self._intake.put(None)
+        worker.join(timeout=timeout)
+        if worker.is_alive():
+            log.warning("ServingFleet scheduler did not exit within "
+                        "%.3gs (drain=%s); failing its in-flight "
+                        "requests", timeout, drain)
+            self._fail_all(RuntimeError(
+                "ServingFleet shut down while the scheduler was "
+                "unresponsive"))
+        for i, srv in enumerate(self._servers):
+            # dead replicas included: a kill() already shut its server
+            # down (GenerationServer.shutdown is idempotent), but an
+            # ORGANICALLY-dead one still owns a watchdog thread and
+            # queued leftovers that must be stopped and failed — the
+            # fleet marking it dead never stopped the server itself
+            try:
+                srv.shutdown(drain=drain, timeout=timeout)
+            except Exception:
+                log.exception("replica %d shutdown failed", i)
+        self._fail_leftovers()
+        _REPL_HEALTHY.set(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- internals -----------------------------------------------------
+    def _check_replica(self, idx: int) -> int:
+        idx = int(idx)
+        if not 0 <= idx < self.n_replicas:
+            raise ValueError(f"replica {idx} out of range "
+                             f"[0, {self.n_replicas})")
+        return idx
+
+    def _wake(self) -> None:
+        """Nudge a sleeping scheduler without enqueueing work."""
+        try:
+            self._intake.put_nowait(_WAKE)
+        except queue.Full:
+            pass                     # a full intake is awake already
+
+    def _mark_migrate(self, idx: int) -> None:
+        """Flag every in-flight request on ``idx`` for migration and
+        cancel its replica-side handle (the handle failing is what
+        hands the request back to the dispatch pass)."""
+        with self._lock:
+            victims = [r for r in self._inflight if r.replica == idx]
+            for req in victims:
+                req._migrate = True
+        for req in victims:
+            inner = req.inner
+            if inner is not None:
+                inner.cancel()
+
+    def _fail_leftovers(self) -> None:
+        """Drain and fail intake entries once the scheduler is gone."""
+        err = RuntimeError("ServingFleet shut down with the request "
+                           "in flight")
+        while True:
+            try:
+                item = self._intake.get_nowait()
+            except queue.Empty:
+                return
+            if isinstance(item, _FleetRequest):
+                self._acct.drop_queued(item.tenant)
+                item._error = err
+                item._event.set()
+
+    def _fail_all(self, err) -> None:
+        with self._lock:
+            victims = self._waiting + self._inflight
+            self._waiting = []
+            self._inflight = []
+        for req in victims:
+            inner = req.inner
+            if inner is not None:
+                inner.cancel()
+            if req._quota_held:
+                self._acct.release(req.tenant)
+            else:
+                self._acct.drop_queued(req.tenant)
+            req._error = err
+            req._event.set()
+        _FLEET_QDEPTH.set(self._intake.qsize())
+
+    def _finish(self, req: _FleetRequest, result=None, error=None,
+                outcome: Optional[str] = None) -> None:
+        """Terminal accounting for one request (already removed from
+        the wait/flight lists by the caller)."""
+        if req._quota_held:
+            self._acct.release(req.tenant)
+            if req._t_dispatch is None:
+                # charged at the quota gate but never dispatched to
+                # any replica: the tokens bought nothing — refund
+                self._acct.refund(req.tenant, req.cost)
+        else:
+            self._acct.drop_queued(req.tenant)
+        if outcome:
+            _REQS.labels(tenant=req.tenant, outcome=outcome).inc()
+        if error is not None:
+            req._error = error
+        else:
+            req._result = result
+            inner = req.inner
+            if (req._t_dispatch is not None and inner is not None
+                    and inner.ttft is not None):
+                req.ttft = (req._t_dispatch - req.t_submit) + inner.ttft
+        req._event.set()
+
+    # -- scheduler passes (scheduler thread only) ----------------------
+    def _ingest(self, item, stop: bool) -> bool:
+        """Returns the updated stop flag."""
+        if item is None:
+            return True
+        if item is _WAKE:
+            return stop
+        with self._lock:
+            self._waiting.append(item)
+        return stop
+
+    def _sweep_health(self, now: float) -> None:
+        """Declare replicas dead after ``dead_after_s`` of continuous
+        unhealthiness (a watchdog recovery flickers for milliseconds —
+        that must not trigger a migration storm) and trigger migration
+        for their in-flight work."""
+        newly_dead = []
+        for i, srv in enumerate(self._servers):
+            with self._lock:
+                if i in self._dead:
+                    continue
+            if srv.healthy():
+                with self._lock:
+                    self._unhealthy_since.pop(i, None)
+                continue
+            with self._lock:
+                t0 = self._unhealthy_since.setdefault(i, now)
+                if now - t0 >= self.dead_after_s:
+                    self._dead.add(i)
+                    newly_dead.append(i)
+        for i in newly_dead:
+            log.warning("ServingFleet: replica %d unhealthy for "
+                        ">= %.3gs — declaring it dead and migrating "
+                        "its requests", i, self.dead_after_s)
+            self._mark_migrate(i)
+        with self._lock:
+            n_up = sum(1 for i in range(self.n_replicas)
+                       if i not in self._dead
+                       and i not in self._draining
+                       and i not in self._unhealthy_since)
+        _REPL_HEALTHY.set(n_up)
+
+    def _reap_waiting(self, now: float) -> None:
+        """Cancelled / deadline-expired requests leave the wait line."""
+        with self._lock:
+            keep, victims = [], []
+            for req in self._waiting:
+                if req.cancelled:
+                    victims.append((req, "cancelled", CancelledError(
+                        "fleet request cancelled")))
+                elif req.deadline is not None and now > req.deadline:
+                    victims.append((req, "expired",
+                                    DeadlineExceededError(
+                                        "fleet request deadline "
+                                        "elapsed before dispatch")))
+                else:
+                    keep.append(req)
+            self._waiting = keep
+        for req, outcome, err in victims:
+            self._finish(req, error=err, outcome=outcome)
+
+    def _count_queued(self, req: _FleetRequest) -> None:
+        """First wait — quota OR capacity — counts the queued outcome
+        (once per request; the label means 'waited >= 1 pass')."""
+        if not req._queued_counted:
+            req._queued_counted = True
+            _REQS.labels(tenant=req.tenant, outcome="queued").inc()
+
+    def _dispatch_pass(self, now: float) -> int:
+        """Walk the wait line in (priority, deadline, arrival) order
+        and dispatch everything quota + capacity allow.  Returns the
+        number dispatched.
+
+        Cost discipline: the quota gate runs FIRST (a blocked
+        tenant's backlog must cost zero replica traffic), and replica
+        ``stats()`` snapshots are taken ONCE per pass — a long wait
+        line must not hammer every replica's lock per request.
+        Intra-pass dispatches fold back in via ``extra_load`` so
+        least-loaded placement still spreads within one pass; only
+        the per-request prefix-warmth probe touches a replica per
+        waiting request, and only after its quota cleared."""
+        with self._lock:
+            if not self._waiting:
+                return 0
+            line = sorted(self._waiting,
+                          key=lambda r: (r.priority,
+                                         r.deadline if r.deadline
+                                         is not None else _INF,
+                                         r.t_submit_m))
+            all_dead = len(self._dead) >= self.n_replicas
+            cand = [i for i in range(self.n_replicas)
+                    if i not in self._dead and i not in self._draining]
+        base = {}
+        for i in cand:
+            st = self._servers[i].stats()
+            if st["healthy"] and not st["draining"]:
+                base[i] = st
+        extra_load = {i: 0 for i in base}
+        extra_blocks = {i: 0 for i in base}   # blocks claimed this
+                                              # pass (free_blocks is a
+                                              # snapshot — without
+                                              # this, one stale count
+                                              # piles a whole burst
+                                              # onto one replica)
+        n_dispatched = 0
+        for req in line:
+            if now < req._not_before:
+                continue             # migration backoff
+            if req.cancelled or (req.deadline is not None
+                                 and now > req.deadline):
+                continue             # next reap pass collects it
+            if all_dead:
+                with self._lock:
+                    if req in self._waiting:
+                        self._waiting.remove(req)
+                self._finish(req, error=NoHealthyReplicaError(
+                    "every fleet replica is dead — the request "
+                    "was never applied; safe to retry"),
+                    outcome="failed")
+                continue
+            if not req._quota_held:
+                if not self._acct.try_dispatch(req.tenant, req.cost,
+                                               now):
+                    self._count_queued(req)
+                    continue
+                req._quota_held = True
+            if not base:
+                # capacity wait: every replica draining/recovering
+                self._count_queued(req)
+                continue
+            views = [{"idx": i,
+                      "warmth": self._servers[i].prefix_warmth(
+                          req.prompt),
+                      "free_blocks": (st["free_blocks"]
+                                      - extra_blocks[i]),
+                      "load": (st["live_slots"] + st["queue_depth"]
+                               + extra_load[i])}
+                     for i, st in base.items()]
+            refused = set()
+            status, idx = self._place(req, views, refused)
+            for i in refused:
+                # a refusing replica (raced drain/shutdown) refuses
+                # everyone: stop re-attempting it this pass
+                base.pop(i, None)
+            if status == "placed":
+                extra_load[idx] += 1
+                bs = base[idx]["block_size"]
+                extra_blocks[idx] += -(-(len(req.prompt)
+                                         + req.n_new) // bs)
+                n_dispatched += 1
+            elif status == "refused":
+                self._count_queued(req)
+        return n_dispatched
+
+    def _place(self, req: _FleetRequest, views: List[dict],
+               refused_out: Optional[set] = None):
+        """Dispatch ``req`` onto the best candidate in ``views``
+        (falling down the ranking when a replica refuses — raced
+        drain/shutdown; refusers are reported through ``refused_out``
+        so a pass can stop re-attempting them).  Returns
+        ``("placed", replica_idx)``, ``("refused", None)`` when every
+        candidate refused, or ``("failed", None)`` when the request
+        terminally failed."""
+        views = list(views)
+        while views:
+            idx, reason = choose_replica(views)
+            if req._migrating:
+                reason = FAILOVER
+            srv = self._servers[idx]
+            remaining = (None if req.deadline is None
+                         else max(req.deadline - time.monotonic(),
+                                  1e-3))
+            try:
+                inner = srv.submit_async(
+                    req.prompt, req.n_new, eos_id=req.eos_id,
+                    seed=req.seed, deadline_s=remaining,
+                    sampling=req.sampling)
+            except RuntimeError:
+                # raced into a draining/shutdown replica: drop it from
+                # the candidate ranking and try the next one
+                if refused_out is not None:
+                    refused_out.add(idx)
+                views = [v for v in views if v["idx"] != idx]
+                continue
+            except Exception as e:
+                with self._lock:
+                    if req in self._waiting:
+                        self._waiting.remove(req)
+                self._finish(req, error=e, outcome="failed")
+                return "failed", None
+            with self._lock:
+                if req in self._waiting:
+                    self._waiting.remove(req)
+                req.inner = inner
+                req.replica = idx
+                req._migrate = False
+                self._inflight.append(req)
+            first = req._t_dispatch is None
+            req._t_dispatch = time.perf_counter()
+            if first:
+                _QWAIT.labels(tenant=req.tenant).observe(
+                    req._t_dispatch - req.t_submit)
+            _DISPATCH.labels(replica=str(idx), reason=reason).inc()
+            if req._migrating:
+                req._migrating = False
+                _REQS.labels(tenant=req.tenant,
+                             outcome="migrated").inc()
+            else:
+                _REQS.labels(tenant=req.tenant,
+                             outcome="admitted").inc()
+            if req.cancelled:
+                inner.cancel()       # raced a cancel mid-placement
+            return "placed", idx
+        return "refused", None       # every candidate refused
+
+    def _completion_pass(self, now: float) -> int:
+        """Resolve finished replica-side handles: deliver results,
+        propagate terminal errors, and REQUEUE migration candidates
+        (dead/hard-drained replica, or a retryable server failure)
+        with jittered backoff.  Returns the number resolved."""
+        with self._lock:
+            flight = list(self._inflight)
+        n_done = 0
+        for req in flight:
+            inner = req.inner
+            if inner is None or not inner.done():
+                if req._migrate:
+                    # the placement is GONE (dead replica or hard
+                    # drain): do not wait for a scheduler that may
+                    # never resolve the cancelled handle — a kill()
+                    # fails handles via shutdown, but an organically-
+                    # dead scheduler resolves nothing, ever.  Abandon
+                    # the old handle and requeue (or finish) now.
+                    n_done += self._abandon_placement(req, now)
+                continue
+            n_done += 1
+            err = None
+            try:
+                result = inner.result(timeout=1.0)
+            except BaseException as e:
+                err, result = e, None
+            if err is None:
+                with self._lock:
+                    if req in self._inflight:
+                        self._inflight.remove(req)
+                self._finish(req, result=result)
+                continue
+            # error classification
+            if isinstance(err, CancelledError) and req.cancelled:
+                self._remove_and_finish(req, err, "cancelled")
+            elif isinstance(err, DeadlineExceededError):
+                self._remove_and_finish(req, err, "expired")
+            elif self._migratable(req, err, now):
+                self._requeue(req, now)
+            else:
+                self._remove_and_finish(req, err, "failed")
+        return n_done
+
+    def _abandon_placement(self, req: _FleetRequest,
+                           now: float) -> int:
+        """A migrating request whose replica-side handle may never
+        resolve: drop the handle and requeue — unless the caller no
+        longer wants it, its deadline is spent, or its migration
+        budget is exhausted (terminal then).  Returns 1 (the request
+        always moves somewhere — progress for the pacing loop)."""
+        if req.cancelled:
+            self._remove_and_finish(req, CancelledError(
+                "fleet request cancelled"), "cancelled")
+        elif req.deadline is not None and now > req.deadline:
+            self._remove_and_finish(req, DeadlineExceededError(
+                "fleet request deadline elapsed while its replica "
+                "was dying"), "expired")
+        elif req.migrations >= self.migration_retries:
+            self._remove_and_finish(req, NoHealthyReplicaError(
+                "request exhausted its migration budget on dying "
+                "replicas — it was never applied; safe to retry"),
+                "failed")
+        else:
+            self._requeue(req, now)
+        return 1
+
+    def _remove_and_finish(self, req: _FleetRequest, err,
+                           outcome: str) -> None:
+        with self._lock:
+            if req in self._inflight:
+                self._inflight.remove(req)
+        self._finish(req, error=err, outcome=outcome)
+
+    def _migratable(self, req: _FleetRequest, err, now: float) -> bool:
+        """A failed in-flight request migrates when the failure was
+        the REPLICA's fault (marked for migration, replica dead or
+        unhealthy, or a typed retryable failure), the caller still
+        wants it, its deadline still has budget, and the migration
+        bound has room.  The request was never partially applied —
+        the survivor re-decodes from scratch, byte-identically."""
+        if req.cancelled:
+            return False
+        if req.deadline is not None and now > req.deadline:
+            return False
+        if req.migrations >= self.migration_retries:
+            return False
+        if req._migrate:
+            return True
+        with self._lock:
+            replica_gone = (req.replica in self._dead
+                            or req.replica in self._unhealthy_since)
+        if replica_gone and isinstance(err, (RetryableServerError,
+                                             RuntimeError,
+                                             CancelledError)):
+            return True
+        # healthy replica, typed retryable failure (watchdog recovery
+        # dropped the slot): same re-placement path, still bounded
+        return isinstance(err, RetryableServerError)
+
+    def _requeue(self, req: _FleetRequest, now: float) -> None:
+        req.migrations += 1
+        delay = backoff_delay(req.migrations - 1,
+                              self.retry_backoff_s, 1.0)
+        with self._lock:
+            if req in self._inflight:
+                self._inflight.remove(req)
+            req.inner = None
+            req.replica = None
+            req._migrate = False
+            req._migrating = True
+            req._not_before = now + delay
+            self._waiting.append(req)
+
+    def _run(self) -> None:
+        stop = False
+        while True:
+            with self._lock:
+                idle = not self._waiting and not self._inflight
+            if idle and not stop:
+                stop = self._ingest(self._intake.get(), stop)
+            while True:                       # opportunistic drain
+                try:
+                    item = self._intake.get_nowait()
+                except queue.Empty:
+                    break
+                stop = self._ingest(item, stop)
+            with self._lock:
+                drain_mode = self._drain_mode
+            if stop and not drain_mode:
+                self._fail_all(RuntimeError(
+                    "ServingFleet shut down with the request in "
+                    "flight"))
+                _FLEET_QDEPTH.set(0)
+                return
+            if stop:
+                with self._lock:
+                    done = not self._waiting and not self._inflight
+                if done and self._intake.empty():
+                    _FLEET_QDEPTH.set(0)
+                    return
+            try:
+                now = time.monotonic()
+                self._sweep_health(now)
+                self._reap_waiting(now)
+                n_disp = self._dispatch_pass(now)
+                n_done = self._completion_pass(now)
+                with self._lock:
+                    busy = bool(self._waiting or self._inflight)
+                    depth = len(self._waiting)
+                _FLEET_QDEPTH.set(depth + self._intake.qsize())
+                if busy and not (n_disp or n_done) and not stop:
+                    # nothing moved: sleep ON the intake so a new
+                    # submit / wake nudge cuts the latency short
+                    try:
+                        stop = self._ingest(
+                            self._intake.get(
+                                timeout=self.poll_interval_s), stop)
+                    except queue.Empty:
+                        pass
+            except Exception:
+                # the fleet scheduler must not die of one bad pass —
+                # log, breathe, keep serving (replica-side failures
+                # already have their own watchdog story)
+                log.exception("ServingFleet scheduler pass failed")
+                time.sleep(0.05)

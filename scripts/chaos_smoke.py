@@ -304,6 +304,52 @@ def main() -> int:
         problems.append("expected exactly 2 watchdog restarts "
                         "(crash + stall)")
 
+    # -- serving fleet: SIGKILL-equivalent death of one of two
+    # replicas mid-decode.  The seed request warms one replica's
+    # prefix cache so affinity routes all four follow-ups there
+    # (2 decoding + 2 queued on the victim); the kill migrates every
+    # one of them to the survivor, byte-identical to offline decode,
+    # with the migrated outcome on the wire.  No FaultInjector here —
+    # the fault-count matrix below stays exact. --------------------
+    from deeplearning4j_tpu.serving import ServingFleet
+
+    fleet_fam = registry.counter("fleet_requests_total",
+                                 labelnames=("tenant", "outcome"))
+
+    def outcome_total(outcome):
+        return sum(c.value for vals, c in fleet_fam._items()
+                   if vals[1] == outcome)
+
+    mig0 = outcome_total("migrated")
+    pf = np.arange(1, 14, dtype=np.int32)
+    ref_fleet = offline.generate(pf[None], n_new=12)[0]
+    with ServingFleet(gpt, n_replicas=2, n_slots=2, max_len=32,
+                      block_size=4, tick_batch=1,
+                      tick_timeout_s=None) as fleet:
+        h_seed = fleet.submit_async(pf, n_new=2)
+        h_seed.result(timeout=300)
+        warm = h_seed.replica
+        hs = [fleet.submit_async(pf, n_new=12) for _ in range(4)]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(h.emitted > 0 for h in hs):
+                break                    # mid-decode on the victim
+            time.sleep(0.001)
+        fleet.kill(warm)
+        for i, h in enumerate(hs):
+            try:
+                if not np.array_equal(h.result(timeout=300),
+                                      ref_fleet):
+                    problems.append(
+                        f"fleet migrated output {i} mismatch")
+            except Exception as e:
+                problems.append(f"fleet migrated request {i} "
+                                f"failed: {e}")
+        if fleet.stats()["healthy_replicas"] != 1:
+            problems.append("fleet survivor count != 1 after kill")
+    if outcome_total("migrated") - mig0 < 1:
+        problems.append("fleet kill produced no migrated requests")
+
     # -- sanitizer: one deliberate nan trip so the series has a
     # labeled child on the wire (check_finite itself is unconditional
     # — DL4J_TPU_SANITIZE gates the CALL SITES, not the check) -------
@@ -350,6 +396,16 @@ def main() -> int:
                 break
         else:
             problems.append(f"{needle} missing from the scrape")
+    # the fleet migration outcome must carry a REAL value on the wire
+    for line in body.splitlines():
+        if (line.startswith("fleet_requests_total{")
+                and 'outcome="migrated"' in line
+                and float(line.rsplit(" ", 1)[1]) > 0):
+            break
+    else:
+        problems.append('fleet_requests_total{outcome="migrated"} '
+                        "missing or 0 on the scrape after a replica "
+                        "kill")
     required += ct.ANALYSIS_SERIES
     required += ['sanitizer_trips_total{mode="nan"}']
     problems += ct.missing_series(body, required)

@@ -75,6 +75,20 @@ PAGED_KV_SERIES = [
     'paged_route_total{path="reference"}',
 ]
 
+# Serving-fleet series (PR 9): the smoke below routes a 2-tenant
+# workload through a 2-replica ServingFleet — the repeated hot-tenant
+# prompt rides affinity to the warm replica (a real prefix hit there),
+# so the admission/dispatch series carry live values on the wire.
+FLEET_SERIES = [
+    'fleet_requests_total{tenant="hot",outcome="admitted"}',
+    'fleet_requests_total{tenant="cold",outcome="admitted"}',
+    'fleet_replica_dispatch_total{replica="0",reason="least_loaded"}',
+    'fleet_replica_dispatch_total{replica="0",reason="affinity"}',
+    "fleet_queue_wait_seconds_bucket",
+    "fleet_replicas_healthy",
+    "fleet_queue_depth",
+]
+
 # Static-analysis subsystem series: the lint counter gets labeled
 # children from emit_analysis_series() below, which also runs a real
 # (small) package-index build so the whole-package-mode series carry
@@ -261,6 +275,33 @@ def main() -> int:
         problems.append("prefix-hit decode diverged from the cold "
                         "decode of the same prompt")
 
+    # -- serving fleet: 2 replicas x 2 tenants through the admission
+    # router — the repeated hot-tenant prompt must ride affinity to
+    # the warm replica and score a real prefix hit THERE -------------
+    from deeplearning4j_tpu.serving import ServingFleet
+
+    with ServingFleet(gpt, n_replicas=2, n_slots=2, max_len=32,
+                      block_size=4, tick_batch=1,
+                      tick_timeout_s=None) as fleet:
+        fp = np.asarray([2, 7, 1, 8, 2, 8, 1, 8, 2], np.int32)
+        out_hot = fleet.submit(fp, n_new=4, tenant="hot", timeout=300)
+        fh = fleet.submit_async(fp, n_new=4, tenant="hot")
+        out_rep = fh.result(timeout=300)
+        out_cold = fleet.submit(np.asarray([6, 5, 4, 3], np.int32),
+                                n_new=4, tenant="cold", timeout=300)
+        if out_cold.shape != (8,):
+            problems.append(
+                f"fleet cold-tenant request: shape {out_cold.shape}")
+        if not np.array_equal(out_hot, out_rep):
+            problems.append("fleet repeat decode diverged from its "
+                            "first decode of the same prompt")
+        if fh.replica is None or \
+                fleet.replica(fh.replica).stats()["prefix_hits"] < 1:
+            problems.append("fleet affinity repeat scored no prefix "
+                            "hit on the warm replica")
+        if fleet.stats()["healthy_replicas"] != 2:
+            problems.append("fleet not fully healthy after the smoke")
+
     # -- static analysis: lint series on the wire ----------------------
     emit_analysis_series(problems)
 
@@ -295,7 +336,8 @@ def main() -> int:
         "generation_server_host_syncs_total",
         'generation_server_scan_ticks_total{k="4"}',
         "generation_server_tokens_per_dispatch",
-    ] + PAGED_KV_SERIES + RESILIENCE_SERIES + ANALYSIS_SERIES
+    ] + PAGED_KV_SERIES + FLEET_SERIES + RESILIENCE_SERIES \
+      + ANALYSIS_SERIES
     problems += missing_series(body, required)
     if lat.count - lat_before != 16:
         problems.append(

@@ -470,6 +470,44 @@ def test_paged_shared_prefix_soak(net, offline):
                     == srv.kv_blocks)
 
 
+def test_stats_prefix_warmth_and_drain(net, offline):
+    """The PR 9 introspection trio on ONE server: stats() is one
+    lock-consistent router view (slots, queue, block headroom,
+    per-instance prefix hit/miss split), prefix_warmth() is a
+    bytes-verified membership probe, and drain() closes admission
+    while already-submitted work completes byte-identically with the
+    scheduler (healthy(), stats()) still alive — distinct from
+    shutdown(drain=True), which also stops the scheduler."""
+    p = np.arange(1, 14, dtype=np.int32)     # 3 full blocks @ bs=4
+    with GenerationServer(net, n_slots=2, max_len=32, block_size=4,
+                          tick_batch=1, tick_timeout_s=None) as srv:
+        st = srv.stats()
+        assert st["healthy"] and not st["draining"]
+        assert st["live_slots"] == 0 and st["free_slots"] == 2
+        assert st["queue_depth"] == 0
+        assert st["free_blocks"] == srv.kv_blocks
+        assert st["prefix_hits"] == 0 and st["prefix_misses"] == 0
+        assert srv.prefix_warmth(p) == 0
+        out = srv.submit(p, n_new=6, timeout=300)
+        assert srv.prefix_warmth(p) == 3     # (13-1)//4 full blocks
+        assert srv.prefix_warmth(
+            np.asarray([9, 9, 9, 9, 9], np.int32)) == 0
+        srv.submit(p, n_new=6, timeout=300)
+        st = srv.stats()
+        assert st["prefix_hits"] == 1 and st["prefix_misses"] == 1
+        assert st["cached_blocks"] == 3
+        # drain with a request in flight (the hit path — compiled)
+        h = srv.submit_async(p, n_new=6)
+        srv.drain()
+        with pytest.raises(RuntimeError, match="draining"):
+            srv.submit(p, n_new=2)
+        np.testing.assert_array_equal(
+            h.result(timeout=300), offline.generate(p[None],
+                                                    n_new=6)[0])
+        assert srv.stats()["draining"] is True
+        assert srv.healthy()                 # draining is not dead
+
+
 def test_generate_rejects_out_of_range_top_k(net):
     # ADVICE r5: JAX index clamping silently disabled filtering before
     gen = TransformerGenerator(net)
