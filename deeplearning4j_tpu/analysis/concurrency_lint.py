@@ -30,9 +30,17 @@ as access sites (their contract is "caller holds the lock") but calls
 to them from outside a ``with self.<lock>:`` block are themselves
 flagged.
 
+Aliasing through locals IS resolved (ISSUE 10): ``s = self`` (and
+chains, ``t = s``) makes ``with s._lock:`` a lock region and
+``s.attr`` a self-attribute access for every rule above — hiding an
+unguarded write behind a one-letter alias no longer blinds the pass.
+A later rebind of the alias to something else is NOT tracked (the
+name counts as ``self`` for the whole method); that pattern reads as
+a bug in its own right.
+
 Known blind spots (ROADMAP): lock objects not stored on ``self``
-(module-level locks, locks passed in), aliasing (``s = self;
-s.attr``), and cross-module subclassing.
+(module-level locks, locks passed in — partially covered by CONC205's
+lock provenance), and cross-module subclassing.
 
 Rules
 -----
@@ -87,6 +95,39 @@ def _is_lock_ctor(expr: ast.AST) -> bool:
     return parts is not None and parts[-1] in _LOCK_CTORS
 
 
+def _self_aliases(method: ast.AST) -> Set[str]:
+    """Local names bound to ``self`` inside ``method`` — ``s = self``
+    and chains (``t = s``) — to a fixed point.  Rebinding an alias to
+    something else later is not tracked: the name counts as ``self``
+    for the whole method (conservative for guarded-inference, and the
+    pattern itself reads as a bug)."""
+    aliases: Set[str] = {"self"}
+    changed = True
+    while changed:
+        changed = False
+        for n in ast.walk(method):
+            if not (isinstance(n, ast.Assign)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id in aliases):
+                continue
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id not in aliases:
+                    aliases.add(t.id)
+                    changed = True
+    return aliases
+
+
+def _attr_accesses_aliased(node: ast.AST, aliases: Set[str]):
+    """``attr_accesses`` over every self-alias base."""
+    for base in aliases:
+        yield from attr_accesses(node, base)
+
+
+def _subscript_stores_aliased(node: ast.AST, aliases: Set[str]):
+    for base in aliases:
+        yield from subscript_store_bases(node, base)
+
+
 class _ClassInfo:
     def __init__(self, node: ast.ClassDef):
         self.node = node
@@ -135,6 +176,14 @@ class _ModuleLint:
         for _, name, _ in attr_accesses(node):
             if "lock" in name.lower():
                 ci.lock_attrs.add(name)
+        # alias-aware lock-name pre-pass: a lock only ever touched as
+        # ``s._lock`` must still register before guarded inference runs
+        for m in ci.methods.values():
+            aliases = _self_aliases(m)
+            if aliases != {"self"}:
+                for _, name, _ in _attr_accesses_aliased(m, aliases):
+                    if "lock" in name.lower():
+                        ci.lock_attrs.add(name)
         # thread targets
         for n in ast.walk(node):
             if isinstance(n, ast.Call):
@@ -146,12 +195,16 @@ class _ModuleLint:
                             tp = dotted(kw.value)
                             if tp and tp[0] == "self" and len(tp) == 2:
                                 ci.thread_targets.add(tp[1])
-        # guarded attributes + per-method access maps
+        # guarded attributes + per-method access maps (alias-aware:
+        # ``s = self`` makes ``s.attr`` a self access and ``with
+        # s._lock:`` a lock region)
         for mname, m in ci.methods.items():
-            in_lock = self._locked_regions(m, ci.lock_attrs)
+            aliases = _self_aliases(m)
+            in_lock = self._locked_regions(m, ci.lock_attrs, aliases)
             whole_locked = mname.endswith("_locked")
             stores, loads = set(), set()
-            for attr_node, name, kind in attr_accesses(m):
+            for attr_node, name, kind in _attr_accesses_aliased(
+                    m, aliases):
                 if name in ci.lock_attrs:
                     continue
                 if kind == "store":
@@ -160,7 +213,7 @@ class _ModuleLint:
                         ci.guarded.add(name)
                 else:
                     loads.add(name)
-            for attr_node, name in subscript_store_bases(m):
+            for attr_node, name in _subscript_stores_aliased(m, aliases):
                 if name in ci.lock_attrs:
                     continue
                 stores.add(name)
@@ -174,15 +227,19 @@ class _ModuleLint:
                 if p and p[0] == "self" and len(p) == 2}
         return ci
 
-    def _locked_regions(self, method: ast.AST,
-                        lock_attrs: Set[str]) -> Set[ast.AST]:
-        """All nodes lexically inside a ``with self.<lock>:`` block."""
+    def _locked_regions(self, method: ast.AST, lock_attrs: Set[str],
+                        aliases: Optional[Set[str]] = None
+                        ) -> Set[ast.AST]:
+        """All nodes lexically inside a ``with self.<lock>:`` block —
+        ``self`` meaning any local alias of it when ``aliases`` is
+        given (``s = self; with s._lock:``)."""
+        bases = aliases if aliases is not None else {"self"}
         inside: Set[ast.AST] = set()
         for n in ast.walk(method):
             if not isinstance(n, ast.With):
                 continue
             if not any(
-                    (lambda p: p and p[0] == "self" and len(p) == 2
+                    (lambda p: p and p[0] in bases and len(p) == 2
                      and p[1] in lock_attrs)(dotted(item.context_expr))
                     for item in n.items):
                 continue
@@ -248,7 +305,8 @@ class _ModuleLint:
             m = ci.methods.get(mname)
             if m is None:
                 continue
-            in_lock = self._locked_regions(m, ci.lock_attrs)
+            aliases = _self_aliases(m)
+            in_lock = self._locked_regions(m, ci.lock_attrs, aliases)
             qn = f"{ci.name}.{mname}"
             reported: Set[Tuple[str, str, int]] = set()
 
@@ -277,22 +335,25 @@ class _ModuleLint:
                         "race is benign and baseline this finding"))
 
             sub_store_nodes = {id(a) for a, _ in
-                               subscript_store_bases(m)}
-            for attr_node, name, kind in attr_accesses(m):
+                               _subscript_stores_aliased(m, aliases)}
+            for attr_node, name, kind in _attr_accesses_aliased(
+                    m, aliases):
                 if id(attr_node) in sub_store_nodes:
                     kind = "store"
                 check(attr_node, name, kind)
 
     def _lint_locked_suffix_calls(self, ci: _ClassInfo) -> None:
         for mname, m in ci.methods.items():
-            in_lock = self._locked_regions(m, ci.lock_attrs)
             if mname.endswith("_locked"):
                 continue     # _locked calling _locked: caller's caller
+            aliases = _self_aliases(m)
+            in_lock = self._locked_regions(m, ci.lock_attrs, aliases)
             for c in ast.walk(m):
                 if not isinstance(c, ast.Call):
                     continue
                 parts = dotted(c.func)
-                if not (parts and parts[0] == "self" and len(parts) == 2
+                if not (parts and parts[0] in aliases
+                        and len(parts) == 2
                         and parts[1].endswith("_locked")):
                     continue
                 if c not in in_lock:

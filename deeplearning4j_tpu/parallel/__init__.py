@@ -23,6 +23,7 @@ from deeplearning4j_tpu.parallel.distributed import (
     global_mesh, host_local_batch_to_global, initialize)
 from deeplearning4j_tpu.parallel.checkpoint import (
     CheckpointListener, ShardedCheckpointer)
+from deeplearning4j_tpu.parallel import elastic
 
 # DL4J-familiar alias: `initialize_distributed` ≙ Spark/Aeron bring-up
 initialize_distributed = initialize
@@ -39,4 +40,4 @@ __all__ = ["MeshConfig", "ShardedTrainer", "ParallelInference",
            "host_local_batch_to_global", "ShardedCheckpointer",
            "CheckpointListener", "ring_attention", "ring_self_attention",
            "gpipe_apply", "stack_block_params", "PipelinedTransformerLM",
-           "measure_scaling"]
+           "measure_scaling", "elastic"]

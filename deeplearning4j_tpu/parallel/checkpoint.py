@@ -10,7 +10,9 @@ logSaving; SURVEY.md §5.3-5.4 'checkpoint-restart driven' elasticity).
 """
 from __future__ import annotations
 
+import json
 import logging
+import os
 from pathlib import Path
 from typing import Any, Optional
 
@@ -23,6 +25,7 @@ except Exception as _e:  # degrade at import, fail loudly on first USE:
 
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.parallel import elastic as _elastic
 from deeplearning4j_tpu.resilience import faults as _faults
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -60,7 +63,8 @@ class ShardedCheckpointer:
     rotation and async writes (preemption safety: the previous save
     completes or is discarded atomically by orbax)."""
 
-    def __init__(self, directory, keep_last: int = 3, async_save: bool = True):
+    def __init__(self, directory, keep_last: int = 3, async_save: bool = True,
+                 world: Optional[int] = None):
         if ocp is None:
             raise ImportError(
                 "ShardedCheckpointer requires orbax-checkpoint, which "
@@ -68,11 +72,53 @@ class ShardedCheckpointer:
                 f"{_ORBAX_IMPORT_ERROR!r}")
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
+        # the LOGICAL world size recorded beside every save (default:
+        # the process count).  A resuming fleet compares it against its
+        # own world to detect an elastic shrink/grow — single-process
+        # trainers whose world is a virtual-device mesh (stage count,
+        # DP ways) can state it explicitly.
+        self.world = None if world is None else int(world)
         opts = ocp.CheckpointManagerOptions(
             max_to_keep=keep_last,
             enable_async_checkpointing=async_save,
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=opts)
+
+    # -- world/layout sidecar -------------------------------------------
+    # Orbax owns the array bytes; the few scalars elastic resume needs
+    # BEFORE a template can even be built (what world saved this step?
+    # which optimizer layout is inside?) live in a tiny JSON beside the
+    # step so a differently-shaped resumer can read them first.
+    def _world_path(self, step: int) -> Path:
+        return self.directory / f"world_{int(step)}.json"
+
+    def _world_meta(self, state: Any) -> dict:
+        import jax
+        meta = {"world": (self.world if self.world is not None
+                          else jax.process_count()),
+                "processes": jax.process_count(),
+                "devices": jax.device_count()}
+        opt = state.get("opt_state") if isinstance(state, dict) else None
+        layout = _elastic.opt_layout(opt)
+        if layout is not None:
+            meta["opt_layout"] = layout
+        if layout == "pipe":
+            run = _elastic.find_pipe_run(opt)
+            if run is not None:
+                meta["pipe_run"] = list(run)
+        return meta
+
+    def world_at(self, step) -> Optional[dict]:
+        """The world/layout metadata recorded when ``step`` was saved
+        (``{"world", "processes", "devices", "opt_layout", ...}``), or
+        None for pre-elastic checkpoints."""
+        if step is None:
+            return None
+        try:
+            with open(self._world_path(step)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
 
     def save(self, step: int, state: Any, metrics: Optional[dict] = None,
              force: bool = False):
@@ -82,17 +128,106 @@ class ShardedCheckpointer:
         self._mgr.save(int(step),
                        args=ocp.args.StandardSave(_globalize(state)),
                        metrics=metrics, force=force)
+        import jax
+        if jax.process_index() == 0:
+            # best-effort sidecar (tiny, atomic via rename): a missing
+            # one only degrades elastic detection to "unknown world"
+            try:
+                tmp = self._world_path(step).with_suffix(".tmp")
+                tmp.write_text(json.dumps(self._world_meta(state)))
+                os.replace(tmp, self._world_path(step))
+            except OSError:
+                log.exception("world sidecar write for step %d failed",
+                              step)
 
     def restore_latest(self, like: Any):
         """Restore the newest step into the structure of `like` (sharded
         arrays are restored with their shardings).  Returns (step, state)
-        or (None, None) when no checkpoint exists."""
+        or (None, None) when no checkpoint exists.
+
+        ELASTIC: when the checkpoint was written by a differently-shaped
+        trainer (pipeline stages vs. plain — the optimizer state's
+        layout differs structurally), the restore retries with the
+        saved layout's template, then re-lays the optimizer state into
+        ``like``'s layout (``parallel.elastic``; byte-preserving per
+        layer).  Plain world-size changes (DP N→M, stage repartition at
+        the same layout) need no retry at all: orbax re-lays global
+        arrays onto whatever shardings the template carries."""
         step = self._mgr.latest_step()
         if step is None:
             return None, None
-        state = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(_globalize(like)))
-        return step, state
+        try:
+            state = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(_globalize(like)))
+            return step, state
+        except Exception as orig:
+            alt = self._alternate_template(like, step)
+            if alt is None:
+                raise
+            try:
+                state = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(_globalize(alt)))
+            except Exception:
+                # the cross-layout retry did not help: the FIRST
+                # failure is the real one (e.g. a transient I/O error
+                # that only looked like a structure mismatch) — never
+                # mask it with the retry's secondary error
+                raise orig
+            converted = _elastic.convert_opt_layout(
+                state["opt_state"], like["opt_state"])
+            if converted is None:       # pragma: no cover - defensive
+                raise orig
+            state["opt_state"] = converted
+            log.info("elastic restore at step %d: optimizer state "
+                     "re-laid from the saved %r layout into the "
+                     "resuming trainer's %r layout (saved world=%s)",
+                     step, self._saved_opt_layout(step)[0],
+                     _elastic.opt_layout(like["opt_state"]),
+                     (self.world_at(step) or {}).get("world"))
+            return step, state
+
+    def _saved_opt_layout(self, step: int):
+        """``(layout, pipe_run)`` of the optimizer state saved at
+        ``step`` — from the world sidecar when present, else derived
+        structurally from the orbax metadata tree (shapes only, no
+        array reads), so a lost/failed sidecar write degrades elastic
+        DETECTION (world comparison) but never elastic RESTORE."""
+        meta = self.world_at(step) or {}
+        layout = meta.get("opt_layout")
+        if layout is not None:
+            run = meta.get("pipe_run")
+            return layout, (tuple(int(v) for v in run) if run else None)
+        try:
+            mtree = self._mgr.item_metadata(step)
+            saved_opt = (mtree.get("opt_state")
+                         if isinstance(mtree, dict) else None)
+        except Exception:               # pragma: no cover - defensive
+            return None, None
+        layout = _elastic.opt_layout(saved_opt)
+        run = (_elastic.find_pipe_run(saved_opt)
+               if layout == "pipe" else None)
+        return layout, run
+
+    def _alternate_template(self, like: Any, step: int):
+        """A restore template in the SAVED optimizer layout, built by
+        re-laying ``like``'s own optimizer template — or None when no
+        cross-layout restore applies (then the original error stands)."""
+        if not isinstance(like, dict) or "opt_state" not in like:
+            return None
+        mine = _elastic.opt_layout(like["opt_state"])
+        saved, run = self._saved_opt_layout(step)
+        if mine == "pipe" and saved != "pipe":
+            # saved per-layer (or unknowable, where per-layer is the
+            # only other layout this pair of trainers produces)
+            return {**like,
+                    "opt_state": _elastic.pipe_to_layers(
+                        like["opt_state"])}
+        if mine == "layers" and saved == "pipe" and run:
+            lo, hi = run
+            return {**like,
+                    "opt_state": _elastic.layers_to_pipe(
+                        like["opt_state"], int(lo), int(hi))}
+        return None
 
     def all_steps(self):
         return list(self._mgr.all_steps())
@@ -103,6 +238,10 @@ class ShardedCheckpointer:
         that landed on some hosts only) discards it so every rank's
         ``restore_latest`` resolves to the agreed common step."""
         self._mgr.delete(int(step))
+        try:
+            self._world_path(step).unlink()
+        except OSError:
+            pass
 
     def wait(self):
         """Block until pending async saves land (call before exit)."""
@@ -118,11 +257,12 @@ class CheckpointListener(TrainingListener):
 
     def __init__(self, directory, save_every_n_iterations: Optional[int] = None,
                  save_every_n_epochs: Optional[int] = None, keep_last: int = 3,
-                 async_save: bool = True):
+                 async_save: bool = True, world: Optional[int] = None):
         self.ckpt = ShardedCheckpointer(directory, keep_last=keep_last,
-                                        async_save=async_save)
+                                        async_save=async_save, world=world)
         self.every_iter = save_every_n_iterations
         self.every_epoch = save_every_n_epochs
+        self.world_at = self.ckpt.world_at   # elastic-resume delegate
         # Last orbax step label saved by THIS listener: when an epoch
         # boundary coincides with an every-N iteration, both hooks would
         # target the same step and orbax raises StepAlreadyExistsError.

@@ -386,6 +386,21 @@ class ShardedTrainer:
         fresh_opt = self._updater.init_state(params)
         restored = self.model.opt_state
         if restored is not None and \
+                jax.tree_util.tree_structure(restored) != \
+                jax.tree_util.tree_structure(fresh_opt):
+            # elastic N→M resume: a checkpoint from a plain (or
+            # differently-staged) trainer carries the per-layer
+            # optimizer layout — restack it into this trainer's pipe
+            # structure (byte-preserving per layer) instead of
+            # discarding momentum
+            from deeplearning4j_tpu.parallel import elastic
+            converted = elastic.convert_opt_layout(restored, fresh_opt)
+            if converted is not None:
+                log.info("restacking restored per-layer optimizer "
+                         "state into the %d-stage pipeline layout",
+                         self.mesh_conf.pipeline)
+                restored = converted
+        if restored is not None and \
                 jax.tree_util.tree_structure(restored) == \
                 jax.tree_util.tree_structure(fresh_opt):
             self._pipe_opt = jax.tree_util.tree_map(
@@ -459,6 +474,19 @@ class ShardedTrainer:
         def place(v):
             parts = [None] * np.ndim(v)
             if self.mesh_conf.data > 1 and np.ndim(v) >= 1:
+                if np.shape(v)[0] % self.mesh_conf.data:
+                    # typed, not an XLA shape error: an elastic
+                    # supervisor must distinguish "this world cannot
+                    # carry the configured global batch" (pick another
+                    # M, or pad the batch) from a training failure
+                    from deeplearning4j_tpu.resilience.errors import (
+                        ElasticWorldError)
+                    raise ElasticWorldError(
+                        f"global batch of {np.shape(v)[0]} examples "
+                        f"does not divide over data={self.mesh_conf.data}"
+                        " — a shrunk/grown fleet keeps the GLOBAL batch "
+                        "size by resizing per-rank microbatches, which "
+                        "only works in whole examples")
                 parts[0] = "data"
             sharding = NamedSharding(self.mesh, P(*parts))
             if multi:

@@ -26,10 +26,12 @@ Every recovery event lands in the PR-1 telemetry registry:
 ``retry_{attempts,backoff_seconds}{op=}``.
 """
 from deeplearning4j_tpu.resilience.coordination import (
-    FleetCoordinator, fleet_resume_fit)
+    FleetCoordinator, SurvivorWorld, fleet_resume_fit,
+    survivor_rendezvous)
 from deeplearning4j_tpu.resilience.errors import (
-    CancelledError, DeadlineExceededError, InjectedFault,
-    RetryableServerError, TrainingPreempted)
+    CancelledError, DeadlineExceededError, ElasticWorldError,
+    FleetResumeExhausted, InjectedFault, RetryableServerError,
+    TrainingPreempted)
 from deeplearning4j_tpu.resilience.faults import (
     FAULT_KINDS, FaultInjector, FaultSpec)
 from deeplearning4j_tpu.resilience.policy import BadStepPolicy
@@ -43,7 +45,8 @@ __all__ = [
     "InjectedFault", "TrainingPreempted", "RetryableServerError",
     "DeadlineExceededError", "CancelledError",
     "BadStepPolicy",
-    "FleetCoordinator", "fleet_resume_fit",
+    "FleetCoordinator", "fleet_resume_fit", "survivor_rendezvous",
+    "SurvivorWorld", "FleetResumeExhausted", "ElasticWorldError",
     "PreemptionGuard", "auto_resume_fit", "request_preemption",
     "preemption_requested", "clear_preemption",
     "retry_call", "backoff_delay",

@@ -33,6 +33,31 @@ class TrainingPreempted(RuntimeError):
         self.step = step
 
 
+class FleetResumeExhausted(RuntimeError):
+    """``fleet_resume_fit`` burned through ``max_restarts`` without the
+    fit completing.  Carries the LAST fleet-agreed checkpoint step and
+    the world size the final attempt ran at, so a supervisor one level
+    up (cluster manager, on-call tooling) can decide whether to retry
+    at a different world or page — instead of parsing an ambiguous
+    re-raised ``TrainingPreempted``."""
+
+    def __init__(self, step=None, world=None, last_error=None):
+        super().__init__(
+            f"fleet resume exhausted its restart budget (last agreed "
+            f"checkpoint step={step}, world={world})")
+        self.step = step
+        self.world = world
+        self.last_error = last_error
+
+
+class ElasticWorldError(RuntimeError):
+    """The requested world size cannot carry the configured workload —
+    e.g. a shrunk fleet whose GLOBAL batch size does not divide over
+    the new data axis (per-rank microbatches can grow, but only in
+    whole examples).  Typed so an elastic supervisor distinguishes
+    'this world is impossible' from a transient training failure."""
+
+
 class RetryableServerError(RuntimeError):
     """The server failed this request through no fault of the request:
     the decode scheduler crashed, was recovered by the watchdog, or was

@@ -41,7 +41,13 @@ the slot scheduler inside each ``GenerationServer``:
   ``resilience.retry.backoff_delay`` jitter, bounded by
   ``migration_retries``) and complete byte-identical to offline
   ``generate()`` — greedy decode is deterministic, so a from-scratch
-  re-decode on the survivor IS the same bytes.
+  re-decode on the survivor IS the same bytes;
+* **elastic scale** (ISSUE 10) — :meth:`ServingFleet.add_replica`
+  joins one more replica built from the founding config (it becomes a
+  dispatch candidate only after its first successful ``stats()``) and
+  :meth:`ServingFleet.remove_replica` scales in through the same
+  drain→migrate machinery — the serving mirror of the training
+  layer's N→M elastic resume.
 
 The fleet is in-process: replicas share the host and its device(s),
 which is the single-chip degenerate of the multi-host layout (each
@@ -237,8 +243,12 @@ class ServingFleet:
         self.retry_backoff_s = float(retry_backoff_s)
         self.poll_interval_s = float(poll_interval_s)
         self.dead_after_s = float(dead_after_s)
-        self._servers = tuple(GenerationServer(net, **server_kwargs)
-                              for _ in range(self.n_replicas))
+        # kept for elastic scale-out: add_replica() constructs
+        # newcomers from the SAME net + config the founders got
+        self._net = net
+        self._server_kwargs = dict(server_kwargs)
+        self._servers = [GenerationServer(net, **server_kwargs)
+                         for _ in range(self.n_replicas)]
         self._acct = TenantAccountant(default_quota, quotas)
         # fleet scheduler state: everything below mutates ONLY under
         # _lock (the GenerationServer discipline, one level up)
@@ -248,6 +258,8 @@ class ServingFleet:
         self._inflight: List[_FleetRequest] = []
         self._dead = set()
         self._draining = set()
+        self._joining = set()     # added replicas not yet dispatchable
+        self._removed = set()     # scaled-in replicas (never candidates)
         self._unhealthy_since: Dict[int, float] = {}
         self._shutdown = False
         self._drain_mode = False
@@ -385,25 +397,91 @@ class ServingFleet:
             self._servers[idx].shutdown(drain=False, timeout=timeout)
         self._wake()
 
+    def add_replica(self) -> int:
+        """LIVE SCALE-OUT: construct one more replica from the fleet's
+        founding ``net`` + server config and join it; returns its
+        index.  The newcomer enters the dispatch candidate set — and
+        the prefix-affinity probe — only after its FIRST successful
+        ``stats()`` (observed by the scheduler's health sweep): a
+        replica still constructing must not catch traffic it cannot
+        report on, and ``fleet_replicas_healthy`` only rises when it
+        actually becomes dispatchable."""
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("ServingFleet has been shut down")
+        # constructed OUTSIDE the lock: replica construction allocates
+        # the KV pool and may compile — the fleet must keep serving
+        srv = GenerationServer(self._net, **self._server_kwargs)
+        with self._lock:
+            if self._shutdown:
+                down = True
+            else:
+                down = False
+                idx = len(self._servers)
+                self._servers.append(srv)
+                self.n_replicas += 1
+                self._joining.add(idx)
+        if down:
+            srv.shutdown(drain=False)
+            raise RuntimeError("ServingFleet has been shut down")
+        log.info("ServingFleet: replica %d constructed; joins the "
+                 "dispatch set after its first successful stats()", idx)
+        self._wake()
+        return idx
+
+    def remove_replica(self, replica: int, timeout: float = 30.0) -> None:
+        """LIVE SCALE-IN: roll ``replica`` out through the existing
+        drain→migrate machinery — admission to it stops, its queued
+        and in-flight requests re-place onto the survivors (completing
+        byte-identical), and once its work has left, the underlying
+        server stops.  The index stays allocated (indices are stable
+        identities requests and telemetry reference) but never becomes
+        a candidate again.  Unknown indices raise ``ValueError``."""
+        idx = self._check_replica(replica)
+        with self._lock:
+            if idx in self._removed:
+                return
+            self._removed.add(idx)
+            self._joining.discard(idx)
+        self.drain(idx, hard=True)
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = any(r.replica == idx for r in self._inflight)
+            if not busy:
+                break
+            time.sleep(self.poll_interval_s)
+        try:
+            self._servers[idx].shutdown(drain=False, timeout=timeout)
+        except Exception:
+            log.exception("removed replica %d shutdown failed", idx)
+        self._wake()
+
     def stats(self) -> dict:
         """Fleet snapshot: per-replica ``GenerationServer.stats()``
-        (plus fleet-side ``dead``/``draining`` flags), wait-line and
-        in-flight depths, dispatchable-replica count, and the
-        per-tenant accounting view."""
+        (plus fleet-side ``dead``/``draining``/``joining``/``removed``
+        flags), wait-line and in-flight depths, dispatchable-replica
+        count, and the per-tenant accounting view."""
         with self._lock:
+            servers = list(self._servers)
             dead = set(self._dead)
             draining = set(self._draining)
+            joining = set(self._joining)
+            removed = set(self._removed)
             waiting = len(self._waiting)
             inflight = len(self._inflight)
         replicas = []
-        for i, srv in enumerate(self._servers):
+        for i, srv in enumerate(servers):
             st = srv.stats()
             st["dead"] = i in dead
             st["draining"] = bool(st["draining"]) or i in draining
+            st["joining"] = i in joining
+            st["removed"] = i in removed
             replicas.append(st)
         healthy = sum(1 for st in replicas
                       if st["healthy"] and not st["dead"]
-                      and not st["draining"])
+                      and not st["draining"] and not st["joining"]
+                      and not st["removed"])
         return {"replicas": replicas, "waiting": waiting,
                 "inflight": inflight, "healthy_replicas": healthy,
                 "tenants": self._acct.snapshot()}
@@ -431,7 +509,9 @@ class ServingFleet:
             self._fail_all(RuntimeError(
                 "ServingFleet shut down while the scheduler was "
                 "unresponsive"))
-        for i, srv in enumerate(self._servers):
+        with self._lock:
+            servers = list(self._servers)
+        for i, srv in enumerate(servers):
             # dead replicas included: a kill() already shut its server
             # down (GenerationServer.shutdown is idempotent), but an
             # ORGANICALLY-dead one still owns a watchdog thread and
@@ -454,9 +534,10 @@ class ServingFleet:
     # -- internals -----------------------------------------------------
     def _check_replica(self, idx: int) -> int:
         idx = int(idx)
-        if not 0 <= idx < self.n_replicas:
-            raise ValueError(f"replica {idx} out of range "
-                             f"[0, {self.n_replicas})")
+        with self._lock:
+            n = len(self._servers)
+        if not 0 <= idx < n:
+            raise ValueError(f"replica {idx} out of range [0, {n})")
         return idx
 
     def _wake(self) -> None:
@@ -548,12 +629,30 @@ class ServingFleet:
     def _sweep_health(self, now: float) -> None:
         """Declare replicas dead after ``dead_after_s`` of continuous
         unhealthiness (a watchdog recovery flickers for milliseconds —
-        that must not trigger a migration storm) and trigger migration
-        for their in-flight work."""
+        that must not trigger a migration storm), trigger migration
+        for their in-flight work, and promote JOINING replicas into
+        the dispatch set on their first successful ``stats()``."""
+        with self._lock:
+            servers = list(self._servers)
+            joining = sorted(self._joining)
+        for i in joining:
+            # the join gate: one successful lock-consistent snapshot
+            # proves the newcomer can answer the placement questions
+            # (free blocks, warmth) dispatch will ask it
+            try:
+                st = servers[i].stats()
+            except Exception:       # pragma: no cover - defensive
+                continue
+            if st["healthy"]:
+                with self._lock:
+                    self._joining.discard(i)
+                log.info("ServingFleet: replica %d reported healthy "
+                         "stats — joined the dispatch set", i)
         newly_dead = []
-        for i, srv in enumerate(self._servers):
+        for i, srv in enumerate(servers):
             with self._lock:
-                if i in self._dead:
+                if i in self._dead or i in self._removed \
+                        or i in self._joining:
                     continue
             if srv.healthy():
                 with self._lock:
@@ -570,9 +669,11 @@ class ServingFleet:
                         "its requests", i, self.dead_after_s)
             self._mark_migrate(i)
         with self._lock:
-            n_up = sum(1 for i in range(self.n_replicas)
+            n_up = sum(1 for i in range(len(self._servers))
                        if i not in self._dead
                        and i not in self._draining
+                       and i not in self._removed
+                       and i not in self._joining
                        and i not in self._unhealthy_since)
         _REPL_HEALTHY.set(n_up)
 
@@ -623,9 +724,16 @@ class ServingFleet:
                                          r.deadline if r.deadline
                                          is not None else _INF,
                                          r.t_submit_m))
-            all_dead = len(self._dead) >= self.n_replicas
-            cand = [i for i in range(self.n_replicas)
-                    if i not in self._dead and i not in self._draining]
+            n = len(self._servers)
+            # terminal only when nothing can EVER take the work: every
+            # non-removed replica is dead and no newcomer is joining
+            all_dead = (not self._joining
+                        and all(i in self._dead or i in self._removed
+                                for i in range(n)))
+            cand = [i for i in range(n)
+                    if i not in self._dead and i not in self._draining
+                    and i not in self._removed
+                    and i not in self._joining]
         base = {}
         for i in cand:
             st = self._servers[i].stats()

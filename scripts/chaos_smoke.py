@@ -180,8 +180,14 @@ def main() -> int:
     # -- preempt-in-pipeline: coordinated fleet restart + pipeline
     # resume (single-process degenerate of the multiproc chaos test) --
     import jax
+    fleet_resumed = registry.counter(
+        "fleet_resumes_total",
+        labelnames=("outcome",)).labels(outcome="resumed")
+    fleet_shrink = registry.counter(
+        "fleet_elastic_resumes_total",
+        labelnames=("direction",)).labels(direction="shrink")
     fleet_b0 = counter("fleet_preempt_broadcasts_total").value
-    fleet_r0 = counter("fleet_resumes_total").value
+    fleet_r0 = fleet_resumed.value
     if jax.device_count() < 2:
         problems.append(f"pipeline chaos run needs >= 2 devices, have "
                         f"{jax.device_count()}")
@@ -201,22 +207,55 @@ def main() -> int:
             return ListDataSetIterator(DataSet(px, py).batch_by(8))
 
         with tempfile.TemporaryDirectory() as d:
+            # world=2 recorded beside every save: the shrink scenario
+            # below resumes the SAME checkpoints on a 1-way world
             ck_p = CheckpointListener(os.path.join(d, "ck"),
-                                      save_every_n_iterations=2)
+                                      save_every_n_iterations=2,
+                                      world=2)
             gpt_p.set_listeners(ck_p)
             with FaultInjector(["preempt@2"]):
                 loss_p = fleet_resume_fit(
                     lambda: tr_p.fit(data_p(), n_epochs=2, resume=True),
-                    mesh=tr_p.mesh, checkpoint=ck_p, max_restarts=2)
+                    mesh=tr_p.mesh, checkpoint=ck_p, max_restarts=2,
+                    world=2)
+            ck_p.ckpt.wait()
+            if gpt_p.epoch_count != 2:
+                problems.append(f"pipeline chaos run finished "
+                                f"{gpt_p.epoch_count}/2 epochs")
+            if loss_p is None or not np.isfinite(loss_p):
+                problems.append(f"pipeline post-preempt loss {loss_p}")
+
+            # -- ELASTIC SHRINK (ISSUE 10): the 2-stage pipeline run's
+            # checkpoints (pipe-structured optimizer state, recorded
+            # world=2) resume on a PLAIN 1-way trainer — the restore
+            # path unstacks the optimizer layout byte-preserving and
+            # the shrink is counted on the wire ---------------------
+            s0 = fleet_shrink.value
+            gpt_s = Gpt(vocab_size=32, max_len=8, d_model=16,
+                        n_layers=2, n_heads=2, d_ff=32, seq_len=8,
+                        compute_dtype=None, use_flash=False,
+                        seed=9).init_graph()
+            tr_s = ShardedTrainer(gpt_s, MeshConfig(data=1))
+            ck_s = CheckpointListener(os.path.join(d, "ck"), world=1)
+            gpt_s.set_listeners(ck_s)
+            loss_s = fleet_resume_fit(
+                lambda: tr_s.fit(data_p(), n_epochs=3, resume=True),
+                mesh=tr_s.mesh, checkpoint=ck_s, max_restarts=1,
+                world=1)
+            ck_s.ckpt.close()
+            if gpt_s.epoch_count != 3:
+                problems.append(f"elastic shrink resume finished "
+                                f"{gpt_s.epoch_count}/3 epochs")
+            if loss_s is None or not np.isfinite(loss_s):
+                problems.append(f"elastic shrink resume loss {loss_s}")
+            if fleet_shrink.value - s0 < 1:
+                problems.append("2-stage checkpoint resumed on the "
+                                "1-way trainer counted no elastic "
+                                "shrink")
             ck_p.ckpt.close()
-        if gpt_p.epoch_count != 2:
-            problems.append(f"pipeline chaos run finished "
-                            f"{gpt_p.epoch_count}/2 epochs")
-        if loss_p is None or not np.isfinite(loss_p):
-            problems.append(f"pipeline post-preempt loss {loss_p}")
         if counter("fleet_preempt_broadcasts_total").value - fleet_b0 < 1:
             problems.append("fleet_preempt_broadcasts_total did not grow")
-        if counter("fleet_resumes_total").value - fleet_r0 < 1:
+        if fleet_resumed.value - fleet_r0 < 1:
             problems.append("fleet_resumes_total did not grow")
 
     # -- serving fault matrix: zero-downtime KV salvage ----------------
@@ -386,7 +425,9 @@ def main() -> int:
     # the fleet/salvage counters must carry the REAL recovery values on
     # the wire, not just exist
     for needle in ("fleet_preempt_broadcasts_total",
-                   "fleet_resumes_total", "kv_slots_salvaged_total",
+                   'fleet_resumes_total{outcome="resumed"}',
+                   'fleet_elastic_resumes_total{direction="shrink"}',
+                   "kv_slots_salvaged_total",
                    "serve_watchdog_restarts_total"):
         for line in body.splitlines():
             if line.startswith(needle + " "):

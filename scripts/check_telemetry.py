@@ -53,7 +53,14 @@ RESILIENCE_SERIES = [
     # (generation_server pool recovery) — chaos_smoke asserts the
     # values after firing real recoveries
     "fleet_preempt_broadcasts_total",
-    "fleet_resumes_total",
+    'fleet_resumes_total{outcome="resumed"}',
+    # elastic N->M resume (ISSUE 10): the smoke below saves a world=2
+    # checkpoint and fleet-resumes it at world=1, so the shrink
+    # counter, world gauge and rendezvous-wait histogram carry live
+    # values over the real scrape
+    'fleet_elastic_resumes_total{direction="shrink"}',
+    "fleet_world_size",
+    "fleet_rendezvous_wait_seconds_bucket",
     "kv_slots_salvaged_total",
     "kv_slots_dropped_total",
     # paged-KV layer: block-granular salvage counters (the slot pair
@@ -301,6 +308,33 @@ def main() -> int:
                             "hit on the warm replica")
         if fleet.stats()["healthy_replicas"] != 2:
             problems.append("fleet not fully healthy after the smoke")
+
+    # -- elastic fleet resume: a checkpoint recorded at world=2 is
+    # fleet-resumed at world=1, so the shrink counter, world gauge and
+    # rendezvous-wait histogram carry REAL values on the scrape ------
+    from deeplearning4j_tpu.parallel import CheckpointListener
+    from deeplearning4j_tpu.resilience import fleet_resume_fit
+
+    elastic = registry.counter("fleet_elastic_resumes_total",
+                               labelnames=("direction",))
+    shrink0 = elastic.labels(direction="shrink").value
+    with tempfile.TemporaryDirectory() as d:
+        em = MultiLayerNetwork(conf).init()
+        ck = CheckpointListener(os.path.join(d, "ck"),
+                                save_every_n_iterations=2,
+                                async_save=False, world=2)
+        em.set_listeners(ck)
+        em.fit(ListDataSetIterator(DataSet(x, y).batch_by(32)),
+               n_epochs=1, async_prefetch=False)
+        fleet_resume_fit(
+            lambda: em.fit(ListDataSetIterator(DataSet(x, y).batch_by(32)),
+                           n_epochs=2, resume=True,
+                           async_prefetch=False),
+            checkpoint=ck, world=1)
+        ck.ckpt.close()
+    if elastic.labels(direction="shrink").value - shrink0 < 1:
+        problems.append("world=2 checkpoint fleet-resumed at world=1 "
+                        "counted no elastic shrink")
 
     # -- static analysis: lint series on the wire ----------------------
     emit_analysis_series(problems)

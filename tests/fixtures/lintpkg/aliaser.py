@@ -1,0 +1,34 @@
+"""Self-alias fixtures (ISSUE 10): the PR 8 recorded blind spot —
+locks reached through local aliases of ``self``.  ``polite*`` are the
+NEGATIVE cases (``with s._lock:`` must count as the lock region, so an
+alias-guarded store stays clean), ``rude*`` the POSITIVE ones (an
+alias cannot hide an unguarded access).  Unlike the rest of this
+package these violations are LOCAL — the per-class pass itself must
+see through the alias."""
+import threading
+
+
+class Aliaser:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+
+    def polite(self, k, v):
+        s = self
+        with s._lock:
+            s._table[k] = v        # guarded THROUGH the alias: clean
+
+    def polite_chained(self, k, v):
+        s = self
+        t = s
+        with t._lock:
+            self._table[k] = v     # the alias's lock region guards
+                                   # plain self accesses too: clean
+
+    def rude(self, k, v):
+        s = self
+        s._table[k] = v            # CONC201: the alias hides nothing
+
+    def rude_peek(self):
+        s = self
+        return s._table            # CONC202: aliased unguarded read

@@ -628,9 +628,15 @@ def _fix_index():
 class TestCrossModule:
     def test_local_passes_are_blind_to_the_fixtures(self):
         # the whole point: every violation in lintpkg crosses a module
-        # boundary, so PR 4's per-module passes see NOTHING
+        # boundary, so PR 4's per-module passes see NOTHING — except
+        # aliaser.py, whose violations are DELIBERATELY local: they
+        # prove the per-class pass resolves self-aliases (``s = self``)
+        # instead of being blinded by them (ISSUE 10)
         _, local, _ = _fix_index()
-        assert local == []
+        assert {(f.rule, f.symbol) for f in local} == {
+            ("CONC201", "Aliaser.rude"),
+            ("CONC202", "Aliaser.rude_peek")}
+        assert not any("polite" in f.symbol for f in local)
 
     def test_jit106_cross_module_host_impurity(self):
         idx, _, _ = _fix_index()
@@ -910,7 +916,7 @@ class TestCrossModule:
                        "--no-cache"])
         out = json.loads(capsys.readouterr().out)
         assert rc == 1                      # fixture violations are new
-        assert out["modules_indexed"] == 7  # the dir WAS indexed
+        assert out["modules_indexed"] == 8  # the dir WAS indexed
         assert any(f["rule"] == "JIT106" for f in out["new"])
 
 

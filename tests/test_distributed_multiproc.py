@@ -259,6 +259,96 @@ def _fleet_kill_mid_step(tmp_path, mode):
     assert res["params_sha"] == ref["params_sha"]
 
 
+def _fleet_elastic_resume(tmp_path, mode, n_from, n_to):
+    """Shared body (ISSUE 10): an ``n_from``-process fleet is REALLY
+    SIGTERM'd mid-step (coordinated checkpoint at one step, world
+    recorded beside it), then resumes at ``n_to`` processes through
+    the elastic path — survivor_rendezvous before initialize, fleet
+    rendezvous + agreement, N→M state resharding — and must finish
+    BYTE-IDENTICAL to a plain (fleet-machinery-free) ``n_to``-process
+    resume of a copy of the same checkpoint."""
+    import shutil
+    out = tmp_path / f"{mode}_{n_from}to{n_to}"
+    out.mkdir()
+
+    # preempt phase: the LAST rank self-SIGTERMs at iteration 3; the
+    # in-band or-reduce checkpoints every rank at the same step
+    port = _free_port()
+    procs = _launch_fleet(port, out, mode, "preempt", nproc=n_from,
+                          extra=("--preempt-rank", str(n_from - 1),
+                                 "--preempt-iter", "3"))
+    for rank, p in enumerate(procs):
+        o = p.communicate(timeout=420)[0].decode()
+        assert p.returncode == 0, f"preempt rank {rank}:\n{o[-3000:]}"
+        assert "FLEET_PREEMPTED" in o
+    marks = [json.load(open(out / f"preempt_rank{r}.json"))
+             for r in range(n_from)]
+    assert len({m["step"] for m in marks}) == 1 and \
+        marks[0]["step"] == 3, marks
+
+    # independent copy for the no-fleet-machinery control restore
+    ref_dir = tmp_path / f"{mode}_{n_from}to{n_to}_ref"
+    shutil.copytree(out, ref_dir)
+
+    # ELASTIC resume at n_to processes (survivor_rendezvous elects the
+    # world; the restore reshards N→M)
+    port = _free_port()
+    procs = _launch_fleet(port, out, mode, "resume", nproc=n_to)
+    for rank, p in enumerate(procs):
+        o = p.communicate(timeout=420)[0].decode()
+        assert p.returncode == 0, f"resume rank {rank}:\n{o[-3000:]}"
+        assert "FLEET_WORKER_OK" in o
+    res = json.load(open(out / "resume_rank0.json"))
+    direction = "elastic_shrink" if n_to < n_from else "elastic_grow"
+    assert res[direction] >= 1, res     # the transition was DETECTED
+
+    # control: plain resume of the same checkpoint at n_to, no fleet
+    port = _free_port()
+    procs = _launch_fleet(port, ref_dir, mode, "plainresume",
+                          nproc=n_to)
+    for rank, p in enumerate(procs):
+        o = p.communicate(timeout=420)[0].decode()
+        assert p.returncode == 0, \
+            f"plainresume rank {rank}:\n{o[-3000:]}"
+        assert "FLEET_WORKER_OK" in o
+    ref = json.load(open(ref_dir / "resume_rank0.json"))
+
+    # the elastic fleet path is exactly the plain restore + training:
+    # identical loss trajectory and BYTE-identical final params
+    assert res["final_iteration"] == ref["final_iteration"]
+    for k, v in res["losses"].items():
+        np.testing.assert_allclose(v, ref["losses"][k], rtol=0, atol=0,
+                                   err_msg=f"step {k}")
+    assert res["params_sha"] == ref["params_sha"]
+
+
+@pytest.mark.slow
+def test_fleet_elastic_shrink_2_to_1_dp(tmp_path):
+    """2-process DP fleet SIGTERM'd mid-step resumes on ONE survivor:
+    the lost host is permanent, the world shrinks, and the survivor's
+    continuation is byte-identical to a fresh 1-process run restored
+    from the same checkpoint (the ROADMAP item 4 remainder)."""
+    _fleet_elastic_resume(tmp_path, "dp", 2, 1)
+
+
+@pytest.mark.slow
+def test_fleet_elastic_shrink_2_to_1_pipeline(tmp_path):
+    """2-process PIPELINE fleet (2 stages across the process boundary)
+    resumes on ONE survivor as a plain 1-way trainer: the pipe-layout
+    optimizer state unstacks byte-preserving into the survivor's
+    per-layer layout, and the continuation matches the machinery-free
+    1-process restore exactly."""
+    _fleet_elastic_resume(tmp_path, "pipe", 2, 1)
+
+
+@pytest.mark.slow
+def test_fleet_elastic_grow_1_to_2_dp(tmp_path):
+    """The mirror image: a 1-process run's checkpoint resumes on a
+    GROWN 2-process fleet (repaired hosts rejoining), byte-identical
+    to the plain 2-process restore of the same checkpoint."""
+    _fleet_elastic_resume(tmp_path, "dp", 1, 2)
+
+
 @pytest.mark.slow
 def test_fleet_coordinated_preempt_and_resume_dp(tmp_path):
     """2-process DP fleet: kill one worker mid-step (real SIGTERM),

@@ -1,0 +1,279 @@
+"""Elastic N→M resume (ISSUE 10): checkpoint layout resharding across
+world sizes, the survivor-quorum rendezvous, and the typed elastic
+failure vocabulary.
+
+The core invariant: re-laying a checkpoint from an N-way trainer onto
+an M-way trainer is a PURE restack — per-layer leaves byte-equal after
+any round-trip — and the world-agnostic counters (``batch_in_epoch``
+counts GLOBAL batches; the RNG stream advances once per GLOBAL step)
+restore identically at every M, so the continued run replays the
+identical global batch stream.
+
+Tier-1 budget note: one pipeline fit (S=2) feeds the whole restore
+matrix — restores themselves never compile.  The continuation matrix
+(training after each N→M restore, and the REAL 2-process SIGTERM →
+1-survivor chaos) is @slow in test_distributed_multiproc.py.
+"""
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import ListDataSetIterator
+from deeplearning4j_tpu.parallel import elastic
+from deeplearning4j_tpu.parallel.checkpoint import CheckpointListener
+from deeplearning4j_tpu.parallel.mesh import MeshConfig
+from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+from deeplearning4j_tpu.resilience import (ElasticWorldError,
+                                           FleetResumeExhausted,
+                                           TrainingPreempted,
+                                           fleet_resume_fit,
+                                           survivor_rendezvous)
+from deeplearning4j_tpu.zoo.gpt import Gpt
+
+
+def _leaves(tree):
+    return jax.tree_util.tree_leaves_with_path(tree)
+
+
+def _assert_bytes_equal(a_tree, b_tree):
+    la, lb = _leaves(a_tree), _leaves(b_tree)
+    assert len(la) == len(lb)
+    for (pa, a), (_, b) in zip(la, lb):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), pa
+
+
+# ---------------------------------------------------------------------------
+# layout transforms: pure host-side restack math
+# ---------------------------------------------------------------------------
+def _layer_tree(n_layers, rng, extra=()):
+    t = {f"layer_{i}": {"W": rng.normal(size=(3, 4)).astype(np.float32),
+                        "b": rng.normal(size=(4,)).astype(np.float32)}
+         for i in range(n_layers)}
+    for k, v in extra:
+        t[k] = v
+    return t
+
+
+def test_stack_unstack_roundtrip_byte_equal():
+    """stack_layers/unstack_pipe are inverse bijections for every
+    (lo, hi) run — per-layer leaves byte-preserved (the [j] slice of
+    the stacked leaf IS the layer's leaf)."""
+    rng = np.random.default_rng(0)
+    tree = _layer_tree(6, rng)
+    for lo, hi in ((1, 5), (0, 6), (2, 4)):
+        pipe = elastic.stack_layers(tree, lo, hi)
+        assert elastic.is_pipe_layout(pipe)
+        assert elastic.pipe_run(pipe) == (lo, hi)
+        back = elastic.unstack_pipe(pipe)
+        _assert_bytes_equal(tree, back)
+    with pytest.raises(ValueError, match="does not cover"):
+        elastic.stack_layers(_layer_tree(3, rng), 1, 5)
+    # a malformed 'pre' (non-layer keys) must RAISE, not silently
+    # collapse to the empty prefix and relabel every block one off
+    bad = elastic.stack_layers(tree, 1, 5)
+    bad["pre"] = {"embedding": bad["pre"]["layer_0"]}
+    with pytest.raises(ValueError, match="non-layer keys"):
+        elastic.pipe_run(bad)
+
+
+def test_opt_layout_conversion_roundtrip():
+    """convert_opt_layout re-lays Adam-style optimizer state (the
+    params-like tree nested under updater keys) between the per-layer
+    and pipe layouts, byte-preserving; unrecognized layouts (vertex-
+    keyed graphs) and same-layout pairs return None."""
+    rng = np.random.default_rng(1)
+    plain = {"m": _layer_tree(4, rng), "v": _layer_tree(4, rng)}
+    pipe_like = {"m": elastic.stack_layers(plain["m"], 1, 3),
+                 "v": elastic.stack_layers(plain["v"], 1, 3)}
+    assert elastic.opt_layout(plain) == "layers"
+    assert elastic.opt_layout(pipe_like) == "pipe"
+    assert elastic.find_pipe_run(pipe_like) == (1, 3)
+    stacked = elastic.convert_opt_layout(plain, pipe_like)
+    assert jax.tree_util.tree_structure(stacked) == \
+        jax.tree_util.tree_structure(pipe_like)
+    back = elastic.convert_opt_layout(stacked, plain)
+    _assert_bytes_equal(plain, back)
+    assert elastic.convert_opt_layout(plain, plain) is None
+    assert elastic.convert_opt_layout({}, plain) is None
+    graphish = {"m": {"vertex_a": np.zeros(2)}}
+    assert elastic.opt_layout(graphish) is None
+    assert elastic.convert_opt_layout(graphish, pipe_like) is None
+
+
+# ---------------------------------------------------------------------------
+# restore matrix: one S=2 pipeline checkpoint restored at M ∈ {1, 2, 4}
+# ---------------------------------------------------------------------------
+def _gpt():
+    return Gpt(vocab_size=24, max_len=8, d_model=8, n_layers=4,
+               n_heads=2, d_ff=16, seq_len=8, compute_dtype=None,
+               use_flash=False, seed=9).init_graph()
+
+
+def _data():
+    rng = np.random.default_rng(7)
+    x = rng.integers(0, 24, (32, 8)).astype(np.int32)
+    y = np.roll(x, -1, axis=1)
+    return ListDataSetIterator(DataSet(x, y).batch_by(8))
+
+
+def test_pipeline_checkpoint_restores_at_every_world(tmp_path):
+    """ONE S=2 pipeline run's checkpoint (pipe-layout optimizer state,
+    recorded world=2) restores onto S ∈ {1(plain), 2, 4} trainers:
+    per-layer params AND converted optimizer leaves byte-equal across
+    every M, and the world-agnostic fast-forward state (iteration,
+    epoch, batch_in_epoch, rng stream) identical — so each restored
+    world replays the identical global batch stream."""
+    m = _gpt()
+    tr = ShardedTrainer(m, MeshConfig(pipeline=2), n_micro=2)
+    ck = CheckpointListener(tmp_path / "ck", save_every_n_iterations=2,
+                            async_save=False, world=2)
+    m.set_listeners(ck)
+    tr.fit(_data(), n_epochs=1)
+    meta = ck.ckpt.world_at(ck.ckpt.all_steps()[-1])
+    assert meta["world"] == 2 and meta["opt_layout"] == "pipe"
+    assert meta["pipe_run"] == [1, 5]
+    ck.ckpt.close()
+
+    restored = {}
+    for world, mesh_conf in ((1, MeshConfig(data=1)),
+                             (2, MeshConfig(pipeline=2)),
+                             (4, MeshConfig(pipeline=4))):
+        mm = _gpt()
+        trr = ShardedTrainer(mm, mesh_conf, n_micro=2)
+        cc = CheckpointListener(tmp_path / "ck", world=world)
+        mm.set_listeners(cc)
+        step = cc.restore_into(mm)
+        assert step == 2
+        restored[world] = (mm, trr, cc)
+
+    ref = restored[2][0]          # same-layout restore = ground truth
+    for world in (1, 4):
+        mm = restored[world][0]
+        _assert_bytes_equal(ref.params_tree, mm.params_tree)
+        assert mm.iteration_count == ref.iteration_count
+        assert mm.epoch_count == ref.epoch_count
+        assert mm.batch_in_epoch == ref.batch_in_epoch
+        assert np.asarray(mm._rng.state()).tobytes() == \
+            np.asarray(ref._rng.state()).tobytes()
+    # the plain restore's optimizer state is the per-layer unstack of
+    # the pipe-saved one, byte-for-byte
+    _assert_bytes_equal(
+        elastic.pipe_to_layers(
+            jax.tree_util.tree_map(np.asarray, ref.opt_state)),
+        jax.tree_util.tree_map(np.asarray, restored[1][0].opt_state))
+    for _, _, cc in restored.values():
+        cc.ckpt.close()
+
+    # a LOST sidecar (failed best-effort write) must not strand the
+    # checkpoint: the saved layout is re-derived from the orbax
+    # metadata tree (shapes only) and the cross-layout restore still
+    # lands byte-identical
+    for side in (tmp_path / "ck").glob("world_*.json"):
+        side.unlink()
+    mm = _gpt()
+    trr = ShardedTrainer(mm, MeshConfig(data=1))
+    cc = CheckpointListener(tmp_path / "ck", world=1)
+    mm.set_listeners(cc)
+    assert cc.ckpt.world_at(2) is None          # sidecar really gone
+    assert cc.restore_into(mm) == 2
+    _assert_bytes_equal(ref.params_tree, mm.params_tree)
+    cc.ckpt.close()
+
+
+def test_global_batch_indivisible_raises_typed():
+    """A world whose data axis cannot divide the GLOBAL batch fails
+    with ElasticWorldError at sharding time — before any device
+    dispatch (no compile in this test)."""
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers_core import (DenseLayer,
+                                                        OutputLayer)
+    conf = (NeuralNetConfiguration.builder().seed(3).list()
+            .layer(DenseLayer(n_in=4, n_out=4, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    tr = ShardedTrainer(MultiLayerNetwork(conf).init(),
+                        MeshConfig(data=2))
+    with pytest.raises(ElasticWorldError, match="does not divide"):
+        tr._shard_batch({"features": np.zeros((3, 4), np.float32)})
+    # divisible batches pass the screen (per-rank microbatch = B/M)
+    out = tr._shard_batch({"features": np.zeros((4, 4), np.float32)})
+    assert out["features"].shape == (4, 4)
+
+
+# ---------------------------------------------------------------------------
+# survivor-quorum rendezvous + typed exhaustion (pure host)
+# ---------------------------------------------------------------------------
+def test_survivor_rendezvous_quorum_and_grace(tmp_path):
+    """Two joiners see each other (expected fast path) and elect the
+    deterministic sorted-host rank order; a later epoch where only one
+    survivor beacons closes on the grace window with world=1 — bounded
+    wait, no hang on the host that never comes back."""
+    res = {}
+
+    def join(h):
+        res[h] = survivor_rendezvous(tmp_path, host_id=h, grace_s=0.5,
+                                     expected=2)
+
+    ts = [threading.Thread(target=join, args=(h,))
+          for h in ("beta", "alpha")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert res["alpha"].world == res["beta"].world == 2
+    assert res["alpha"].hosts == res["beta"].hosts == ("alpha", "beta")
+    assert res["alpha"].rank == 0 and res["beta"].rank == 1
+
+    w = survivor_rendezvous(tmp_path, host_id="alpha", grace_s=0.2,
+                            expected=2, epoch=1)
+    assert w == (1, 0, ("alpha",))     # survivor-quorum: M=1, rank 0
+    with pytest.raises(ValueError, match="plain name"):
+        survivor_rendezvous(tmp_path, host_id="a/b")
+
+
+def test_survivor_rendezvous_commit_prevents_split_brain(tmp_path):
+    """The committed world.json is the single source of truth: a host
+    that beacons AFTER the quorum froze adopts nothing and raises
+    typed (its supervisor retries next epoch) instead of initializing
+    a second, differently-sized fleet against the same checkpoint."""
+    import os
+    w = survivor_rendezvous(tmp_path, host_id="early", grace_s=0.1,
+                            expected=1)
+    assert w == (1, 0, ("early",))
+    with pytest.raises(ElasticWorldError, match="froze.*without"):
+        survivor_rendezvous(tmp_path, host_id="late", grace_s=5.0,
+                            expected=1)
+    # a world.json from a PREVIOUS round (older than the grace window)
+    # is a consumed epoch: the next round walks forward automatically
+    # instead of counting ghost beacons as live hosts
+    world_path = tmp_path / "_rendezvous" / "0" / "world.json"
+    old = world_path.stat().st_mtime - 3600
+    os.utime(world_path, (old, old))
+    beacon = tmp_path / "_rendezvous" / "0" / "early.json"
+    os.utime(beacon, (old, old))
+    w2 = survivor_rendezvous(tmp_path, host_id="round2", grace_s=0.2,
+                             expected=1)
+    assert w2 == (1, 0, ("round2",))
+    assert (tmp_path / "_rendezvous" / "1" / "world.json").exists()
+
+
+def test_fleet_resume_exhausted_typed():
+    """Burning max_restarts raises FleetResumeExhausted carrying the
+    last checkpoint step and the world size (typed — a supervisor
+    dispatches on it), with the final failure as __cause__."""
+    calls = []
+
+    def fit_fn():
+        calls.append(1)
+        raise TrainingPreempted(5)
+
+    with pytest.raises(FleetResumeExhausted) as ei:
+        fleet_resume_fit(fit_fn, max_restarts=2, world=3)
+    assert ei.value.step == 5 and ei.value.world == 3
+    assert isinstance(ei.value.__cause__, TrainingPreempted)
+    assert len(calls) == 3                 # initial + 2 restarts

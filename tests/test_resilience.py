@@ -728,11 +728,12 @@ def test_fleet_agreement_discards_uncommon_steps(tmp_path, monkeypatch):
     assert 2 in steps and max(steps) > 2
     monkeypatch.setattr(distributed, "min_reduce",
                         lambda value, mesh=None: 2)
-    r0 = FLEET_RESUMES.value
+    resumed = FLEET_RESUMES.labels(outcome="resumed")
+    r0 = resumed.value
     agreed = FleetCoordinator().agree_resume_step(ck)
     assert agreed == 2
     assert max(ck.ckpt.all_steps()) == 2       # newer steps discarded
-    assert FLEET_RESUMES.value - r0 == 1
+    assert resumed.value - r0 == 1
     step, _ = ck.ckpt.restore_latest(ck._state(m))
     assert step == 2
     ck.ckpt.close()
@@ -751,7 +752,9 @@ def test_fleet_resume_fit_preempt_bit_identical(tmp_path, rng):
     m = _model()
     ck = CheckpointListener(tmp_path / "ck", save_every_n_iterations=5)
     m.set_listeners(ck)
-    resumes = REG.counter("fleet_resumes_total")
+    resumes = REG.counter(
+        "fleet_resumes_total",
+        labelnames=("outcome",)).labels(outcome="resumed")
     r0 = resumes.value
     with FaultInjector(["preempt@8"]):
         loss = fleet_resume_fit(

@@ -289,6 +289,64 @@ def test_organic_replica_death_migrates_unresolved_handles(net,
         assert fleet.stats()["healthy_replicas"] == 1
 
 
+def test_add_and_remove_replica_live_scale(net, offline):
+    """Elastic serving (ISSUE 10): ``remove_replica`` rolls a replica
+    out through the drain→migrate machinery (its in-flight work
+    completes on the survivor, byte-identical), ``add_replica`` joins
+    a newcomer that enters the dispatch candidate set only after its
+    first successful ``stats()`` — and then serves byte-identical
+    outputs; ``fleet_replicas_healthy`` tracks both transitions, and
+    removing an unknown index raises typed."""
+    reg = telemetry.get_registry()
+    gauge = reg.gauge("fleet_replicas_healthy")
+    p = np.arange(1, 14, dtype=np.int32)
+    ref = offline.generate(p[None], n_new=12)[0]
+    with ServingFleet(net, n_replicas=2, n_slots=2, max_len=32,
+                      block_size=4, tick_batch=1,
+                      tick_timeout_s=None) as fleet:
+        with pytest.raises(ValueError, match="out of range"):
+            fleet.remove_replica(7)
+        # pin work on one replica via the affinity seed, then scale it
+        # in mid-flight: the work must migrate and finish byte-equal
+        h_seed = fleet.submit_async(p, n_new=2)
+        h_seed.result(timeout=300)
+        victim = h_seed.replica
+        survivor = 1 - victim
+        hs = [fleet.submit_async(p, n_new=12) for _ in range(2)]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(h.emitted > 0 for h in hs):
+                break
+            time.sleep(0.001)
+        fleet.remove_replica(victim)
+        for h in hs:
+            np.testing.assert_array_equal(h.result(timeout=300), ref)
+        st = fleet.stats()
+        assert st["replicas"][victim]["removed"] is True
+        assert st["healthy_replicas"] == 1
+        # a removed index never rejoins the candidate set
+        np.testing.assert_array_equal(
+            fleet.submit(p, n_new=12, timeout=300), ref)
+        # scale out: the newcomer joins only after a successful
+        # stats() (the scheduler's health sweep promotes it)
+        idx = fleet.add_replica()
+        assert idx == 2 and fleet.n_replicas == 3
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if fleet.stats()["healthy_replicas"] == 2:
+                break
+            time.sleep(0.005)
+        st = fleet.stats()
+        assert st["healthy_replicas"] == 2
+        assert st["replicas"][idx]["joining"] is False
+        assert gauge.value == 2
+        # route through the newcomer exclusively: byte parity holds
+        fleet.drain(survivor)
+        h_new = fleet.submit_async(p, n_new=12)
+        np.testing.assert_array_equal(h_new.result(timeout=300), ref)
+        assert h_new.replica == idx
+
+
 @pytest.mark.slow
 def test_fleet_chaos_matrix_kill_and_hard_drain(net, offline):
     """3-replica churn soak (scan fusion ON — the default

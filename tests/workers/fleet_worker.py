@@ -6,8 +6,19 @@ or-reduce), and a fresh fleet session resumes through
 to a bit-identical finish — for both the DP and the PIPELINE trainer
 path.
 
+ELASTIC (ISSUE 10): the resume phase may run at a DIFFERENT nproc than
+the phase that saved the checkpoints — a 2-process fleet's checkpoint
+resuming on 1 survivor (or growing 1→2).  The worker records the world
+beside every save (``CheckpointListener(world=nproc)``), survivors
+pass a real ``survivor_rendezvous`` over the shared out_dir before
+``initialize()`` (electing rank order from whoever beacons), and the
+dump carries the elastic shrink/grow counters so the parent can assert
+the transition was detected.  ``phase=plainresume`` is the control: the
+same restore WITHOUT any fleet machinery (no coordinator, no
+rendezvous) — the elastic path must land byte-identical to it.
+
 Usage: fleet_worker.py <rank> <nproc> <port> <out_dir> <mode:dp|pipe>
-       <n_epochs> <phase:ref|preempt|resume>
+       <n_epochs> <phase:ref|preempt|resume|plainresume>
        [--preempt-rank R --preempt-iter N]
 """
 import hashlib
@@ -32,6 +43,18 @@ if "--preempt-rank" in sys.argv:
     preempt_iter = int(sys.argv[sys.argv.index("--preempt-iter") + 1])
 
 from deeplearning4j_tpu.parallel import distributed  # noqa: E402
+
+if phase == "resume":
+    # ELASTIC entry: a restarted survivor does not assume the world —
+    # it beacons into the shared directory and joins whoever shows up
+    # (here the parent restarts exactly nproc processes, so the quorum
+    # closes on the expected-count fast path; the grace window is the
+    # real-loss bound).  The elected rank must agree with the assigned
+    # one — both orders sort the same host ids.
+    from deeplearning4j_tpu.resilience import survivor_rendezvous
+    w = survivor_rendezvous(out_dir, host_id=f"host{rank:03d}",
+                            grace_s=10.0, expected=nproc)
+    assert (w.world, w.rank) == (nproc, rank), (w, nproc, rank)
 
 distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
                        num_processes=nproc, process_id=rank)
@@ -107,9 +130,12 @@ class _SelfSigterm(TrainingListener):
 listeners = [_Recorder()]
 ck = None
 if phase != "ref":
-    # sync saves: every rank participates in each multiprocess write
+    # sync saves: every rank participates in each multiprocess write.
+    # world=nproc rides beside every save so a differently-sized
+    # resumer detects the elastic transition.
     ck = CheckpointListener(os.path.join(out_dir, "ckpt"),
-                            save_every_n_iterations=2, async_save=False)
+                            save_every_n_iterations=2, async_save=False,
+                            world=nproc)
     listeners.append(ck)
 if phase == "preempt" and rank == preempt_rank:
     listeners.append(_SelfSigterm())
@@ -122,11 +148,18 @@ def dump(tag):
     h = hashlib.sha256()
     for leaf in leaves:
         h.update(np.ascontiguousarray(jax.device_get(leaf)).tobytes())
+    from deeplearning4j_tpu import telemetry
+    elastic = telemetry.counter("fleet_elastic_resumes_total",
+                                labelnames=("direction",))
     with open(os.path.join(out_dir, f"{tag}_rank{rank}.json"),
               "w") as f:
         json.dump({"rank": rank, "params_sha": h.hexdigest(),
                    "losses": {str(k): v for k, v in losses.items()},
-                   "final_iteration": model.iteration_count}, f)
+                   "final_iteration": model.iteration_count,
+                   "elastic_shrink":
+                       elastic.labels(direction="shrink").value,
+                   "elastic_grow":
+                       elastic.labels(direction="grow").value}, f)
 
 
 if phase == "ref":
@@ -145,10 +178,16 @@ elif phase == "preempt":
                   "w") as f:
             json.dump({"rank": rank, "step": e.step}, f)
         print("FLEET_PREEMPTED", rank, e.step)
+elif phase == "plainresume":
+    # the control: identical restore with ZERO fleet machinery — the
+    # elastic fleet path must land byte-identical to this
+    loss = trainer.fit(data(), n_epochs=n_epochs, resume=True)
+    dump("resume")
+    print("FLEET_WORKER_OK", rank, loss)
 else:
     loss = fleet_resume_fit(
         lambda: trainer.fit(data(), n_epochs=n_epochs, resume=True),
-        mesh=trainer.mesh, checkpoint=ck)
+        mesh=trainer.mesh, checkpoint=ck, world=nproc)
     dump("resume")
     print("FLEET_WORKER_OK", rank, loss)
 if ck is not None:
