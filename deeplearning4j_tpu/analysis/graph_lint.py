@@ -32,6 +32,15 @@ GRAPH305 (error)   shape inference failed: abstract evaluation of the
 GRAPH306 (warning) inferred f64 output: an output abstractly evaluates
                    to float64 from float32 inputs (silent promotion in
                    the op chain).
+GRAPH307 (info)    skipped: dynamic control flow — a while_loop/cond
+                   node's subgraphs execute via ``_exec_while`` /
+                   ``_exec_cond``, outside the registry, so the arity
+                   and inference rules cannot see inside them.  The
+                   skip used to be SILENT (ROADMAP small note); now
+                   every dynamic-control-flow node reports exactly
+                   what was not checked, so scan/while-heavy graphs
+                   (the speculative-decode era's shape) are never
+                   invisibly half-linted.
 """
 from __future__ import annotations
 
@@ -117,6 +126,24 @@ def lint_samediff(sd, name: str = "samediff",
                 "or a loss variable",
                 "prune it (rewrites should drop orphaned nodes) or "
                 "designate the output"))
+        # GRAPH307: dynamic control flow — announce the blind spot
+        # instead of skipping silently.  The body subgraphs run
+        # through _exec_while/_exec_cond rather than the registry
+        # lowering, so GRAPH303's arity probe and the eval_shape
+        # inference below never enter them; a per-node diagnostic
+        # keeps that limitation visible in the report.
+        if node.op_name in ("while_loop", "cond"):
+            inner = sorted(k for k in ("cond", "body", "then",
+                                       "orelse")
+                           if k in (node.attrs or {}))
+            findings.append(_finding(
+                "GRAPH307", "info", name, sym,
+                f"skipped: dynamic control flow — '{node.op_name}' "
+                f"subgraph(s) {inner} execute outside the registry "
+                "and were not arity-checked or shape-inferred",
+                "lint the subgraphs directly (lint_samediff on "
+                "node.attrs['body'] etc.) when they carry "
+                "nontrivial structure"))
         # GRAPH303: arity vs the registered lowering
         opdef = OP_REGISTRY.get(node.op_name)
         if opdef is not None and node.op_name not in ("while_loop",

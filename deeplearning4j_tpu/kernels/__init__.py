@@ -8,9 +8,11 @@ from deeplearning4j_tpu.kernels.flash_attention import (
     xla_attention)
 from deeplearning4j_tpu.kernels.paged_attention import (
     paged_decode_attention, paged_decode_attention_reference,
-    paged_gather)
+    paged_gather, paged_verify_attention,
+    paged_verify_attention_reference)
 
 __all__ = ["attention", "flash_attention", "mask_to_bias",
            "paged_decode_attention", "paged_decode_attention_reference",
-           "paged_gather", "reset_route_log", "route_log",
-           "xla_attention"]
+           "paged_gather", "paged_verify_attention",
+           "paged_verify_attention_reference", "reset_route_log",
+           "route_log", "xla_attention"]

@@ -179,6 +179,155 @@ def _paged_decode_pallas(q, k_pool, v_pool, block_table, pos,
     )(block_table, pos, q, k_pool, v_pool)
 
 
+def paged_verify_attention_reference(q, k_pool, v_pool, block_table,
+                                     pos0, scale: float):
+    """W-query verification attention through the block table, stripe
+    math, UNROLLED per query row: query row j of slot b sits at
+    position ``pos0[b] + j`` and attends over positions <= its own.
+
+    The unroll is the parity contract, not a style choice: each row
+    runs EXACTLY the single-query decode step's einsum/softmax shapes
+    ([B, h, 1, L]) against the once-gathered table view, because a
+    W-row score einsum regroups XLA's head-dim reduction and drifts
+    from the sequential decode ticks by ulps (measured on CPU — the
+    same lesson PR 7 learned about padded key gathers).  Rows write
+    nothing here; the caller has already scattered the chunk's K/V
+    into the pool, and the causal mask hides in-chunk future rows the
+    way it hides stale stripe tails in the decode step."""
+    kl = paged_gather(k_pool, block_table)
+    vl = paged_gather(v_pool, block_table)
+    L = kl.shape[2]
+    W = q.shape[1]
+    cols = jnp.arange(L)[None, :]
+    rows = []
+    for j in range(W):
+        qq = q[:, j][:, :, None, :]                  # [B, h, 1, dh]
+        s = jnp.einsum("bhqd,bhkd->bhqk", qq, kl).astype(jnp.float32)
+        s = s * scale
+        valid = (cols <= (pos0 + j)[:, None])[:, None, None, :]
+        s = jnp.where(valid, s, -1e9)
+        p = jax.nn.softmax(s, axis=-1).astype(vl.dtype)
+        rows.append(jnp.einsum("bhqk,bhkd->bhqd", p, vl)[:, :, 0, :])
+    return jnp.stack(rows, axis=1)                   # [B, W, h, dh]
+
+
+def _lane_bcast3(stat, width):
+    """3-D variant of the flash module's ``_lane_bcast`` for
+    [h, W, _LANES] running stats (W rides the sublane axis)."""
+    if width % _LANES == 0:
+        return jnp.tile(stat, (1, 1, width // _LANES))
+    return stat[:, :, :1] if width > _LANES else stat[:, :, :width]
+
+
+def _verify_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, bs: int, mb: int, W: int,
+                   scale: float):
+    """Grid (B, max_blocks): the decode kernel's streaming-softmax
+    recurrence with W query rows per slot instead of one — query row w
+    sits at position pos0 + w, so the in-block causal mask compares
+    each key's position against a per-row query position.  Blocks past
+    the DEEPEST query's context skip compute entirely."""
+    b, kb = pl.program_id(0), pl.program_id(1)
+    h, dh = q_ref.shape[1], q_ref.shape[3]
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    p0 = pos_ref[b]
+
+    @pl.when(kb * bs <= p0 + W - 1)
+    def _compute():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]   # (h, W, dh), (h, bs, dh)
+        s = lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale    # (h, W, bs)
+        j = kb * bs + lax.broadcasted_iota(jnp.int32, (h, W, bs), 2)
+        qp = p0 + lax.broadcasted_iota(jnp.int32, (h, W, bs), 1)
+        s = jnp.where(j <= qp, s, _NEG)
+        m_prev, l_prev = m_ref[:], l_ref[:]                # (h, W, 128)
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(s, axis=2, keepdims=True))
+        p = jnp.exp(s - _lane_bcast3(m_new, bs))
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * corr + jnp.sum(p, axis=2, keepdims=True)
+        pv = lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)            # (h, W, dh)
+        acc_ref[:] = acc_ref[:] * _lane_bcast3(corr, dh) + pv
+
+    @pl.when(kb == mb - 1)
+    def _finish():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)   # masked rows only
+        out = (acc_ref[:] / _lane_bcast3(l_safe, dh)).astype(o_ref.dtype)
+        o_ref[0] = out.transpose(1, 0, 2)      # (h, W, dh) -> (W, h, dh)
+
+
+def _paged_verify_pallas(q, k_pool, v_pool, block_table, pos0,
+                         scale: float):
+    B, W, h, dh = q.shape
+    bs = k_pool.shape[2]
+    mb = block_table.shape[1]
+    qh = q.transpose(0, 2, 1, 3)               # (B, h, W, dh)
+    kv_spec = pl.BlockSpec(
+        (1, h, bs, dh), lambda b, kb, tbl, p: (tbl[b, kb], 0, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, mb),
+        in_specs=[
+            pl.BlockSpec((1, h, W, dh),
+                         lambda b, kb, tbl, p: (b, 0, 0, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=pl.BlockSpec((1, W, h, dh),
+                               lambda b, kb, tbl, p: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, W, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((h, W, _LANES), jnp.float32),  # running denom
+            pltpu.VMEM((h, W, dh), jnp.float32),      # output acc
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_verify_kernel, bs=bs, mb=mb, W=W,
+                          scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, W, h, dh), q.dtype),
+        compiler_params=_dimsem("parallel", "arbitrary"),
+        interpret=_interpret(),
+    )(block_table, pos0, qh, k_pool, v_pool)
+
+
+def paged_verify_attention(q, k_pool, v_pool, block_table, pos0,
+                           scale: Optional[float] = None):
+    """softmax(q . K_table^T) V_table for a CHUNK of W query tokens
+    per slot — the speculative verification read: query row j of slot
+    b is the j-th token of the verified chunk, at position
+    ``pos0[b] + j``, attending over every position <= its own
+    (in-chunk earlier rows included; the caller scatters the whole
+    chunk's K/V into the pool before this read, exactly as the decode
+    tick writes-then-reads its single row).
+
+    ``q`` [B, W, h, dh]; pools / table / scale as
+    :func:`paged_decode_attention`; ``pos0`` [B] int32.  Routes to the
+    multi-query Pallas kernel on TPU, else to the per-row-unrolled
+    reference — the byte-parity path the speculative greedy-parity
+    tests pin (CPU tier-1 always exercises it)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if _route() == "pallas":
+        _ROUTE_PALLAS.inc()
+        return _paged_verify_pallas(q, k_pool, v_pool, block_table,
+                                    pos0, float(scale))
+    _ROUTE_REFERENCE.inc()
+    return paged_verify_attention_reference(q, k_pool, v_pool,
+                                            block_table, pos0,
+                                            float(scale))
+
+
 def _route() -> str:
     """'pallas' | 'reference' — trace-time decision.  CPU/interpret
     backends take the reference path (it is the byte-parity contract

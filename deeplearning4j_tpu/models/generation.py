@@ -162,6 +162,49 @@ def _block_decode_step_paged(ly: TransformerEncoderBlock, params,
     return y, kpool, vpool
 
 
+def _block_verify_step_paged(ly: TransformerEncoderBlock, params,
+                             kpool, vpool, x, table, wblk, woff, pos0):
+    """W-token verification step for speculative decode: one block's
+    forward over a chunk of W tokens per slot, K/V written through the
+    block table at (``wblk``, ``woff``) [B, W] and attention read back
+    through :func:`~deeplearning4j_tpu.kernels.paged_verify_attention`
+    with query row j at position ``pos0 + j``.
+
+    ``x`` is FLAT [B*W, d] — every matmul and layer norm here runs at
+    the 2-D shapes that are row-bitwise-stable on the backends (the
+    decode step's [b, d] @ W and a [B*W, d] @ W agree per row where a
+    [B, W, d] batched contraction need not), and the attention unrolls
+    per query row inside the kernel's reference path.  Together that
+    makes this chunked step's outputs AND cache writes byte-identical
+    to W sequential ``_block_decode_step_paged`` ticks — the invariant
+    speculative greedy parity rests on."""
+    BW, d = x.shape
+    B, W = wblk.shape
+    h, dh = ly.n_heads, d // ly.n_heads
+    from deeplearning4j_tpu.kernels import paged_verify_attention
+    cast = lambda w: w.astype(x.dtype)
+
+    qkv = x @ cast(params["Wqkv"]) + cast(params["bqkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split = lambda z: z.reshape(B, W, h, dh)
+    q, k, v = split(q), split(k), split(v)
+    kpool = kpool.at[wblk, :, woff, :].set(k)
+    vpool = vpool.at[wblk, :, woff, :].set(v)
+
+    att = paged_verify_attention(q, kpool, vpool, table, pos0,
+                                 scale=1.0 / (dh ** 0.5))
+    att = att.reshape(BW, d)
+    att = att @ cast(params["Wo"]) + cast(params["bo"])
+    hdn = _layer_norm(x + att, params["ln1_g"], params["ln1_b"], ly.eps)
+
+    from deeplearning4j_tpu.nn.activations import get_activation
+    act = get_activation(ly.activation or "gelu")
+    ffn = act(hdn @ cast(params["W1"]) + cast(params["b1"]))
+    ffn = ffn @ cast(params["W2"]) + cast(params["b2"])
+    y = _layer_norm(hdn + ffn, params["ln2_g"], params["ln2_b"], ly.eps)
+    return y, kpool, vpool
+
+
 def _embed_prompt(ly: EmbeddingSequenceLayer, params, ids):
     """[b, t0] int prompt -> [b, t0, d] (positions 0..t0-1)."""
     y = jnp.take(params["W"], ids.astype(jnp.int32), axis=0)
@@ -394,6 +437,44 @@ class TransformerGenerator:
         x, (kc, vc) = jax.lax.scan(body, x, (blk_stack, kc, vc))
         logits = (x.astype(jnp.float32) @ head_p["W"] + head_p["b"])
         return logits, kc, vc
+
+    def _verify_rows_paged(self, emb_p, blk_stack, head_p, kc, vc,
+                           toks, pos0, epos, table, wblk, woff):
+        """Speculative verification forward: ONE batched pass over a
+        chunk of W tokens per slot — ``toks`` [B, W] (the anchor + the
+        draft's proposals, inactive rows masked to 0), ``pos0`` [B]
+        the chunk's base position per slot, ``epos`` [B, W] the embed
+        positions (masked rows clamped to 0 so the positional take
+        never reads out of bounds — the PR 2 NaN class), ``wblk`` /
+        ``woff`` [B, W] the per-token write targets through the
+        slot's block table (masked rows at the scratch block 0).
+
+        Returns (logits [B, W, V], kc, vc): logits at EVERY chunk
+        position — G_j is the target's distribution after consuming
+        tokens 0..j, which is both the acceptance judge and the held
+        logits the round hands forward.  Flat-row matmuls + the
+        per-row attention contract (``_block_verify_step_paged``)
+        make logits AND cache writes bitwise equal to W sequential
+        ``_step_paged`` ticks."""
+        B, W = toks.shape
+        ly = self.blocks[0]
+        flat_tok = toks.reshape(B * W).astype(jnp.int32)
+        y = jnp.take(emb_p["W"], flat_tok, axis=0)
+        if self.emb.add_positional:
+            y = y + jnp.take(emb_p["P"], epos.reshape(B * W), axis=0)
+        if self.emb.layer_norm:
+            y = _layer_norm(y, emb_p["g"], emb_p["b"], self.emb.eps)
+        x = y.astype(self.compute_dtype)
+
+        def body(h, layer):
+            p, kc_l, vc_l = layer
+            h, kc_l, vc_l = _block_verify_step_paged(
+                ly, p, kc_l, vc_l, h, table, wblk, woff, pos0)
+            return h, (kc_l, vc_l)
+
+        x, (kc, vc) = jax.lax.scan(body, x, (blk_stack, kc, vc))
+        logits = (x.astype(jnp.float32) @ head_p["W"] + head_p["b"])
+        return logits.reshape(B, W, -1), kc, vc
 
     def _prefill_rows_chunked(self, emb_p, blk_stack, head_p, suffix,
                               pk, pv, p0, last_ix):

@@ -110,9 +110,27 @@ Cancelled / deadline-expired active slots are killed device-side (a
 tiny jitted ``remaining``-zeroing op) so they stop burning ticks
 instead of decoding out their budget as zombies.
 
-Not here yet (ROADMAP open items): speculative decode (the [B, K]
-staging buffer + per-slot ``emitted`` counters are the accept/reject
-machinery it will reuse) and a TP/mesh-sharded tick.
+SPECULATIVE multi-token decode (``speculative={...}``, PR 11): a
+cheap draft model runs K tokens ahead per slot through its own block
+table (``dtable`` — ordinary pool blocks holding the first
+``draft_layers`` layers of the pool leaves, claimed at admission in
+the same block economy), and the target model verifies the whole
+K+1-token chunk in ONE batched pass (``_verify_rows_paged`` +
+``kernels.paged_verify_attention``) — the agreeing prefix commits, the
+first disagreement falls back to the target's own argmax, so greedy
+output stays BYTE-IDENTICAL to non-speculative decode at every
+acceptance pattern (the verification runs flat-row matmuls and
+per-row-unrolled attention precisely so its logits and cache writes
+are bitwise equal to sequential ticks).  Up to ``rounds`` such rounds
+fuse into one dispatch, staged in the same [B, R*W] buffer /
+``emitted``-counter machinery the multi-tick scan uses.  Sampled
+slots fall the pool back to the plain scan (greedy acceptance has no
+rejection-sampling form here); draft staleness from fallback ticks
+costs acceptance rate, never parity.  ``generation_server_spec_
+{proposed,accepted}_total`` + the acceptance-rate gauge watch the
+draft's quality in production.
+
+Not here yet (ROADMAP open items): a TP/mesh-sharded tick.
 """
 from __future__ import annotations
 
@@ -132,6 +150,7 @@ from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.analysis import sanitize as _sanitize
 from deeplearning4j_tpu.models.generation import (TransformerGenerator,
                                                   _filter_logits_rows)
+from deeplearning4j_tpu.parallel import speculative as _speculative
 from deeplearning4j_tpu.parallel.inference import _bucket
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience.errors import (CancelledError,
@@ -255,6 +274,24 @@ _KV_BLK_DROPPED = telemetry.counter(
     "kv_blocks_dropped_total",
     "previously-used KV blocks zeroed by a pool recovery (implicated "
     "slots' private blocks + poisoned cache entries)")
+# Speculative-decode series: proposed counts every draft token offered
+# for verification, accepted the ones the target's own argmax agreed
+# with — their ratio is THE health number of a speculative deployment
+# (rate ~1 means the draft models the target well and every verify
+# commits ~K+1 tokens; rate ~0 means the expensive verification is
+# buying ~1 token per round and the draft is pure overhead).
+_SPEC_PROPOSED = telemetry.counter(
+    "generation_server_spec_proposed_total",
+    "draft tokens proposed for target verification (K per active "
+    "slot per speculative round)")
+_SPEC_ACCEPTED = telemetry.counter(
+    "generation_server_spec_accepted_total",
+    "draft proposals the batched target verification accepted "
+    "(committed byte-identical to non-speculative greedy decode)")
+_SPEC_ACCEPT_RATE = telemetry.gauge(
+    "generation_server_spec_acceptance_rate",
+    "cumulative accepted/proposed draft-token ratio of the most "
+    "recently dispatching speculative server")
 
 
 def _pow2_floor(n: int) -> int:
@@ -273,9 +310,13 @@ def _pow2_floor(n: int) -> int:
 # prefix hits first, then fresh); ``matched`` — how many leading
 # entries are copy-free prefix-cache hits; ``hashes`` — the prompt's
 # full-block chain hashes (for registering the new blocks after the
-# prefill COMMITS); ``n_fresh`` — blocks claimed off the free list.
+# prefill COMMITS); ``n_fresh`` — blocks claimed off the free list;
+# ``dphys`` — the DRAFT model's physical blocks (speculative decode:
+# always fresh, never prefix-shared — same pool, same free list, so
+# draft KV competes in the same admission economy).
 _AdmitPlan = namedtuple("_AdmitPlan", ("phys", "matched", "hashes",
-                                       "n_fresh"))
+                                       "n_fresh", "dphys"),
+                        defaults=((),))
 
 
 def _kill_slots(state, mask):
@@ -380,6 +421,16 @@ class GenerationServer:
     and prefills only the uncached suffix; retired prefix blocks stay
     resident (LRU-evicted on demand).
 
+    ``speculative`` turns on draft-verified multi-token decode: a
+    dict with any of ``k`` (draft proposals per round, default 4),
+    ``rounds`` (max rounds fused per dispatch, default 2),
+    ``draft_layers`` (self-draft depth — the target truncated to its
+    first layers, default half the stack) or ``draft_net`` (an
+    external proposer; same vocab/heads/width, depth <= target).
+    Greedy outputs stay byte-identical to ``speculative=None``; the
+    win is committed tokens per expensive target pass (up to k+1),
+    paid for with ~2x blocks per admission (the draft's table).
+
     Resilience knobs: ``tick_timeout_s`` arms the watchdog (None
     disables it; the stuck-tick deadline scales by the in-flight scan
     length — a K-tick scan legitimately runs ~K x longer);
@@ -398,6 +449,7 @@ class GenerationServer:
                  block_size: int = 16,
                  kv_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
+                 speculative: Optional[dict] = None,
                  queue_limit: int = 1024,
                  tick_timeout_s: Optional[float] = 30.0,
                  request_deadline_s: Optional[float] = None,
@@ -421,13 +473,26 @@ class GenerationServer:
         # capacity-neutral default: the same HBM the old per-slot
         # stripes occupied, repackaged as shareable blocks (shrink it
         # to trade capacity for concurrency headroom per chip)
+        self._spec = (_speculative.SpecConfig.build(gen, speculative)
+                      if speculative is not None else None)
+        if self._spec is not None:
+            demb = self._spec.draft.gen.emb
+            if demb.add_positional and self.max_len > demb.max_len:
+                raise ValueError(
+                    f"max_len {self.max_len} exceeds the DRAFT "
+                    f"model's positional table ({demb.max_len} rows)")
+        # a speculative slot pins TWO tables' worth of blocks (target
+        # + draft), so the capacity-neutral default and the one-max-
+        # length-request floor both double with speculation on
+        blocks_per_max = self.max_blocks * (2 if self._spec else 1)
         self.kv_blocks = (int(kv_blocks) if kv_blocks is not None
-                          else self.n_slots * self.max_blocks)
-        if self.kv_blocks < self.max_blocks:
+                          else self.n_slots * blocks_per_max)
+        if self.kv_blocks < blocks_per_max:
             raise ValueError(
                 f"kv_blocks={self.kv_blocks} cannot hold one "
-                f"max-length request ({self.max_blocks} blocks of "
-                f"{self.block_size} tokens)")
+                f"max-length request ({blocks_per_max} blocks of "
+                f"{self.block_size} tokens"
+                + (", draft table included)" if self._spec else ")"))
         self.prefix_cache = bool(prefix_cache)
         if (top_k is not None or top_p is not None) and temperature <= 0:
             raise ValueError("top_k/top_p need temperature > 0 "
@@ -491,6 +556,10 @@ class GenerationServer:
         # the split (the global series aggregates every replica)
         self._n_prefix_hits = 0
         self._n_prefix_misses = 0
+        # per-INSTANCE speculative tallies (same reasoning: the fleet
+        # router ranks replicas on THEIR acceptance, not the process's)
+        self._n_spec_proposed = 0
+        self._n_spec_accepted = 0
         self._stop_event = threading.Event()   # ends the watchdog
         # retire prior DEAD servers' series before adding ours: the
         # last-known 0 stays scrapeable until the next construction,
@@ -541,6 +610,11 @@ class GenerationServer:
             # per-slot block table: logical block j of the slot lives
             # in pool block table[slot, j]; 0 = unallocated (scratch)
             "table": jnp.zeros((B, self.max_blocks), jnp.int32),
+            # the DRAFT model's block table (speculative decode; rides
+            # along as zeros when speculation is off — the draft's KV
+            # occupies the first draft.n_layers layers of the same
+            # pool leaves under these block ids)
+            "dtable": jnp.zeros((B, self.max_blocks), jnp.int32),
         }
         # commit atomically: this also runs on the watchdog's recovery
         # path while the (fenced) scheduler may still be snapshotting.
@@ -579,6 +653,12 @@ class GenerationServer:
             emb_p, blk_stack, head_p = (cast(emb_p), cast(blk_stack),
                                         cast(head_p))
         self._params = (emb_p, blk_stack, head_p)
+        if self._spec is not None:
+            # the draft refreshes WITH the target (a self-draft
+            # ALIASES the cast target params — its layer slice happens
+            # in-trace, zero extra device memory; an external draft
+            # re-snapshots its own net)
+            self._draft_params = self._spec.draft.params(self._params)
 
     def healthy(self) -> bool:
         """True while the scheduler thread is alive and admission is
@@ -620,6 +700,16 @@ class GenerationServer:
                 "cached_blocks": len(self._block_hash),
                 "prefix_hits": self._n_prefix_hits,
                 "prefix_misses": self._n_prefix_misses,
+                # speculative view for the fleet router: spec_k > 0
+                # means an admission here pins ~2x blocks (target +
+                # draft tables), and the acceptance rate is the
+                # replica's effective tokens-per-verify multiplier
+                "spec_k": (self._spec.k if self._spec else 0),
+                "spec_proposed": self._n_spec_proposed,
+                "spec_accepted": self._n_spec_accepted,
+                "spec_acceptance_rate": (
+                    self._n_spec_accepted / self._n_spec_proposed
+                    if self._n_spec_proposed else 0.0),
             }
 
     def prefix_warmth(self, prompt_ids) -> int:
@@ -732,7 +822,12 @@ class GenerationServer:
             if entry is None or entry[1] != tok:
                 break                # miss — or a hash collision,
             matched_ids.append(entry[0])   # which must NOT map in
-        need = total - len(matched_ids)
+        # speculative decode: the DRAFT's KV table needs the same
+        # block count, always fresh (draft rows are proposal-history-
+        # dependent, never prefix-shareable) — claimed from the SAME
+        # free list, so draft KV competes in the same economy
+        dneed = total if self._spec is not None else 0
+        need = total - len(matched_ids) + dneed
         # matched hits sitting in the evictable LRU are about to be
         # CLAIMED, not evicted — they don't count as reclaimable
         ev_matched = sum(1 for blk in matched_ids
@@ -754,8 +849,10 @@ class GenerationServer:
         fresh = [self._blocks_free.pop() for _ in range(need)]
         for blk in fresh:
             self._block_ref[blk] = 1
+        dphys = fresh[need - dneed:] if dneed else []
+        fresh = fresh[:need - dneed]
         return _AdmitPlan(matched_ids + fresh, len(matched_ids),
-                          hashes, len(fresh))
+                          hashes, len(fresh) + len(dphys), dphys)
 
     def _register_prefix_locked(self, plan: _AdmitPlan):
         """After the prefill COMMITS, publish the request's new full
@@ -1024,6 +1121,11 @@ class GenerationServer:
                     "tk": state["tk"],
                     "tp": state["tp"],
                     "table": tbl,
+                    # untouched by the plain tick: a speculative
+                    # server's fallback scans (sampled slots live)
+                    # leave the draft's KV stale, which costs
+                    # acceptance on later rounds, never correctness
+                    "dtable": state["dtable"],
                 }
                 emitted = emitted + active.astype(jnp.int32)
                 return (kc, vc, state, emitted), tok
@@ -1043,20 +1145,177 @@ class GenerationServer:
                                              donate_argnums=(3, 4, 5))
         return fn
 
+    def _spec_fn(self, R: int):
+        """R speculative rounds fused into ONE dispatch (cached per R;
+        the speculative analogue of ``_decode_scan``).  Each round:
+        anchor from the held target logits, K draft proposals through
+        the slot's draft table (the first ``draft.n_layers`` pool
+        layers), ONE batched W = K+1-token target verification through
+        the slot's block table, then :func:`speculative.accept_greedy`
+        — the committed tokens stage into a [B, R*W] device buffer at
+        each slot's running cursor, so the host unpacks exactly the
+        PR 5 way (``toks_h[slot, :emitted]``).
+
+        Masking: a round's writes past a slot's remaining budget land
+        in the scratch block 0 with embed positions clamped to 0 (the
+        PR 2 OOB-positional NaN class), and rejected-suffix rows roll
+        back by ``pos`` simply not advancing over them — the blocks
+        were claimed at admission, so the next round overwrites in
+        place.  Returns ``(kc, vc, state, toks [B, R*W], emitted [B],
+        n_alive, proposed, accepted)`` — the last two feed the
+        ``generation_server_spec_*`` counters."""
+        key = ("spec", int(R))
+        fn = self._scan_cache.get(key)
+        if fn is not None:
+            return fn
+        gen = self._gen
+        spec = self._spec
+        dgen = spec.draft.gen
+        d = spec.draft.n_layers
+        K = spec.k
+        W = K + 1
+        bs = self.block_size
+        B = self.n_slots
+
+        def spec_fn(emb_p, blk_stack, head_p, demb_p, dblk, dhead_p,
+                    kc, vc, state):
+            # the draft's layer slice happens IN-TRACE: a self-draft
+            # passes the target's stack verbatim (zero extra device
+            # memory) and an external draft's own d-layer stack
+            # slices to itself
+            dblk = jax.tree_util.tree_map(lambda a: a[:d], dblk)
+            jidx = jnp.arange(W)[None, :]
+
+            def round_body(carry, _):
+                kc, vc, state, staged, emitted, prop, acc = carry
+                active = state["remaining"] > 0
+                pos, rem = state["pos"], state["remaining"]
+                tbl, dtbl = state["table"], state["dtable"]
+                anchor = jnp.where(
+                    active, jnp.argmax(state["logits"], axis=-1),
+                    0).astype(jnp.int32)
+
+                # -- draft: K cheap proposals through the draft table.
+                # The scan runs W = K+1 consume steps, not K: step j
+                # consumes chunk token v_j at pos+j (writing its draft
+                # KV) and proposes v_{j+1}.  The LAST step's proposal
+                # is discarded, but its WRITE matters — on a full
+                # accept the round advances pos over v_K, and a draft
+                # row never consumed would leave a hole in the draft's
+                # context that degrades every later round's proposals
+                # (measured: full-depth self-draft acceptance fell to
+                # 2/3 without it; 1.0 with it).
+                kcd, vcd = kc[:d], vc[:d]
+
+                def dstep(c, j):
+                    kcd, vcd, tok = c
+                    ok = active & (j < rem)
+                    p = jnp.where(ok, pos + j, 0)
+                    bidx = jnp.take_along_axis(
+                        dtbl, (p // bs)[:, None], axis=1)[:, 0]
+                    wblk = jnp.where(ok, bidx, 0)
+                    woff = jnp.where(ok, p % bs, 0)
+                    lg, kcd, vcd = dgen._step_paged(
+                        demb_p, dblk, dhead_p, kcd, vcd, tok, p,
+                        dtbl, wblk, woff)
+                    nxt = jnp.where(ok, jnp.argmax(lg, axis=-1),
+                                    0).astype(jnp.int32)
+                    return (kcd, vcd, nxt), tok
+
+                (kcd, vcd, _), consumed = jax.lax.scan(
+                    dstep, (kcd, vcd, anchor), jnp.arange(W))
+                kc = kc.at[:d].set(kcd)
+                vc = vc.at[:d].set(vcd)
+                v = consumed.T                            # [B, W]
+
+                # -- verify: one batched W-token target pass
+                okv = active[:, None] & (jidx < rem[:, None])
+                p = pos[:, None] + jidx
+                epos = jnp.where(okv, p, 0)
+                vtok = jnp.where(okv, v, 0)
+                bidx = jnp.take_along_axis(
+                    tbl, jnp.where(okv, p // bs, 0), axis=1)
+                wblk = jnp.where(okv, bidx, 0)
+                woff = jnp.where(okv, p % bs, 0)
+                pos0 = jnp.where(active, pos, 0)
+                G, kc, vc = gen._verify_rows_paged(
+                    emb_p, blk_stack, head_p, kc, vc, vtok, pos0,
+                    epos, tbl, wblk, woff)
+                g = jnp.argmax(G, axis=-1).astype(jnp.int32)
+                c, rem_after = _speculative.accept_greedy(
+                    v, g, active, rem, state["eos"])
+                sel = jnp.maximum(c - 1, 0)
+                new_logits = G[jnp.arange(B), sel]
+                state = {
+                    "pos": jnp.where(active, pos + c, pos),
+                    "remaining": jnp.where(active, rem_after, rem),
+                    "eos": state["eos"],
+                    "logits": jnp.where(active[:, None], new_logits,
+                                        state["logits"]),
+                    "key": state["key"],
+                    "temp": state["temp"],
+                    "tk": state["tk"],
+                    "tp": state["tp"],
+                    "table": tbl,
+                    "dtable": dtbl,
+                }
+                # -- stage the commits at each slot's cursor (the
+                # [B, K]-buffer idiom from PR 5, cursor-scattered;
+                # uncommitted columns dump into the extra column)
+                rows = jnp.arange(B)[:, None]
+                keep = active[:, None] & (jidx < c[:, None])
+                cols = jnp.where(keep, emitted[:, None] + jidx, R * W)
+                staged = staged.at[rows, cols].set(v)
+                emitted = emitted + c
+                # proposals that COULD commit: at most remaining-1
+                # beyond the anchor (the draft's tail past a slot's
+                # budget is masked garbage, not a real proposal), and
+                # when a committed EOS ended the stream (rem_after 0
+                # with budget left) everything behind the cut was
+                # flushed, not rejected — so a perfect draft scores
+                # acceptance exactly 1.0 through budget tails AND
+                # EOS-terminated requests
+                prop_i = jnp.clip(jnp.minimum(K, rem - 1), 0, K)
+                prop_i = jnp.where((rem_after == 0) & (c < rem),
+                                   jnp.maximum(c - 1, 0), prop_i)
+                prop = prop + jnp.sum(jnp.where(
+                    active, prop_i, 0).astype(jnp.int32))
+                acc = acc + jnp.sum(jnp.maximum(c - 1, 0))
+                return (kc, vc, state, staged, emitted, prop, acc), None
+
+            staged0 = jnp.zeros((B, R * W + 1), jnp.int32)
+            emitted0 = jnp.zeros((B,), jnp.int32)
+            (kc, vc, state, staged, emitted, prop, acc), _ = \
+                jax.lax.scan(round_body,
+                             (kc, vc, state, staged0, emitted0,
+                              jnp.int32(0), jnp.int32(0)),
+                             None, length=R)
+            n_alive = jnp.sum((state["remaining"] > 0)
+                              .astype(jnp.int32))
+            return (kc, vc, state, staged[:, :R * W], emitted,
+                    n_alive, prop, acc)
+
+        fn = self._scan_cache[key] = jax.jit(spec_fn,
+                                             donate_argnums=(6, 7, 8))
+        return fn
+
     def _scatter_rows(self, pool, rows, phys):
         """Scatter prefill K/V rows into pool blocks: ``rows``
-        [n_layers, 1, h, T, dh] with T a block-size multiple, ``phys``
-        [T // block_size] int32 physical block ids (entries past the
-        slot's allocation point at the scratch block 0 — pad rows land
-        there harmlessly)."""
+        [n_rows_layers, 1, h, T, dh] with T a block-size multiple,
+        ``phys`` [T // block_size] int32 physical block ids (entries
+        past the slot's allocation point at the scratch block 0 — pad
+        rows land there harmlessly).  Writes the LEADING
+        ``rows.shape[0]`` pool layers, so the target path (all layers)
+        and the draft path (the draft's first d layers; the rest of a
+        draft block stays zero, never read) share this."""
         bs = self.block_size
         nl, _, h, T, dh = rows.shape
         blocks = rows[:, 0].reshape(nl, h, T // bs, bs, dh) \
                            .transpose(0, 2, 1, 3, 4)
-        return pool.at[:, phys].set(blocks)
+        return pool.at[:nl, phys].set(blocks)
 
     def _arm_slot(self, state, logits, slot, t0, n_new, eos_id, key,
-                  temp, tk, tp, table_row):
+                  temp, tk, tp, table_row, dtable_row):
         """Slot device-state update shared by both admit programs."""
         return {
             "pos": state["pos"].at[slot].set(t0),
@@ -1071,6 +1330,8 @@ class GenerationServer:
             "tp": state["tp"].at[slot].set(tp),
             "table": jax.lax.dynamic_update_slice(
                 state["table"], table_row[None], (slot, 0)),
+            "dtable": jax.lax.dynamic_update_slice(
+                state["dtable"], dtable_row[None], (slot, 0)),
         }
 
     def _admit_miss_fn(self, tb: int):
@@ -1083,25 +1344,40 @@ class GenerationServer:
         if key in self._admit_cache:
             return self._admit_cache[key]
         gen = self._gen
+        spec = self._spec
 
         def admit(emb_p, blk_stack, head_p, kc, vc, state, prompt, t0,
                   slot, n_new, eos_id, key, temp, tk, tp, phys,
-                  table_row):
+                  table_row, dtable_row, *draft_ops):
             # t0 picks the last REAL position's logits out of the
             # padded bucket
             logits, ks, vs = gen._prefill_rows(emb_p, blk_stack,
                                                head_p, prompt, t0)
             kc = self._scatter_rows(kc, ks, phys)
             vc = self._scatter_rows(vc, vs, phys)
+            if spec is not None:
+                # draft prefill over the SAME padded prompt: the
+                # draft's KV must cover the whole context before it
+                # can propose (its logits are discarded — rounds
+                # re-feed from the anchor).  In-trace layer slice: a
+                # self-draft's operand is the target stack verbatim.
+                demb_p, dblk, dhead_p, dphys = draft_ops
+                dblk = jax.tree_util.tree_map(
+                    lambda a: a[:spec.draft.n_layers], dblk)
+                _, dks, dvs = spec.draft.gen._prefill_rows(
+                    demb_p, dblk, dhead_p, prompt, t0)
+                kc = self._scatter_rows(kc, dks, dphys)
+                vc = self._scatter_rows(vc, dvs, dphys)
             state = self._arm_slot(state, logits, slot, t0, n_new,
-                                   eos_id, key, temp, tk, tp, table_row)
+                                   eos_id, key, temp, tk, tp, table_row,
+                                   dtable_row)
             return kc, vc, state
 
         fn = self._admit_cache[key] = jax.jit(admit,
                                               donate_argnums=(3, 4, 5))
         return fn
 
-    def _admit_hit_fn(self, sb: int, matched: int):
+    def _admit_hit_fn(self, sb: int, matched: int, dtb: int = 0):
         """Prefix-HIT admission program (cached per (suffix bucket,
         matched blocks)): gather the ``matched`` cached blocks as the
         key prefix, chunked-prefill ONLY the suffix, scatter the
@@ -1109,15 +1385,23 @@ class GenerationServer:
         EXACT-length — padding inside the key axis would regroup XLA's
         softmax/matmul reductions and break byte parity with the
         full-prompt prefill, so ``matched`` is a compile-key dimension
-        (bounded by max_blocks) instead of a padded pow2."""
-        key = ("hit", sb, matched)
+        (bounded by max_blocks) instead of a padded pow2.
+
+        With speculation on, the DRAFT still prefills the FULL prompt
+        (its blocks are never prefix-shared, so there is nothing
+        cached to skip) at its own pow2 bucket ``dtb`` — the hit
+        path's prefill saving applies to the target's n layers, the
+        draft re-pays its d cheap ones."""
+        key = ("hit", sb, matched, dtb)
         if key in self._admit_cache:
             return self._admit_cache[key]
         gen = self._gen
+        spec = self._spec
 
         def admit(emb_p, blk_stack, head_p, kc, vc, state, suffix, p0,
                   last_ix, t0, slot, n_new, eos_id, key, temp, tk, tp,
-                  prefix_phys, phys, table_row):
+                  prefix_phys, phys, table_row, dtable_row,
+                  *draft_ops):
             nl = kc.shape[0]
             h, bs, dh = kc.shape[2], kc.shape[3], kc.shape[4]
             gather = lambda pool: jnp.take(pool, prefix_phys, axis=1) \
@@ -1128,8 +1412,17 @@ class GenerationServer:
                 emb_p, blk_stack, head_p, suffix, pk, pv, p0, last_ix)
             kc = self._scatter_rows(kc, ks, phys)
             vc = self._scatter_rows(vc, vs, phys)
+            if spec is not None:
+                demb_p, dblk, dhead_p, dprompt, dphys = draft_ops
+                dblk = jax.tree_util.tree_map(
+                    lambda a: a[:spec.draft.n_layers], dblk)
+                _, dks, dvs = spec.draft.gen._prefill_rows(
+                    demb_p, dblk, dhead_p, dprompt, t0)
+                kc = self._scatter_rows(kc, dks, dphys)
+                vc = self._scatter_rows(vc, dvs, dphys)
             state = self._arm_slot(state, logits, slot, t0, n_new,
-                                   eos_id, key, temp, tk, tp, table_row)
+                                   eos_id, key, temp, tk, tp, table_row,
+                                   dtable_row)
             return kc, vc, state
 
         fn = self._admit_cache[key] = jax.jit(admit,
@@ -1148,7 +1441,24 @@ class GenerationServer:
         p0 = matched * bs
         table_row = np.zeros((self.max_blocks,), np.int32)
         table_row[:len(plan.phys)] = plan.phys
+        dtable_row = np.zeros((self.max_blocks,), np.int32)
+        dtable_row[:len(plan.dphys)] = plan.dphys
         emb_p, blk_stack, head_p = self._params
+
+        def draft_ops(dtb):
+            """Draft-prefill operands (speculative only): the draft's
+            params, its full-prompt pad to the ``dtb`` bucket, and its
+            scatter targets."""
+            dpad = np.zeros((1, dtb), np.int32)
+            dpad[0, :req.t0] = req.prompt
+            n_dc = dtb // bs
+            dscatter = np.zeros((n_dc,), np.int32)
+            dhead = plan.dphys[:n_dc]
+            dscatter[:len(dhead)] = dhead
+            demb_p, dblk, dhead_p = self._draft_params
+            return (demb_p, dblk, dhead_p, jnp.asarray(dpad),
+                    jnp.asarray(dscatter))
+
         # snapshot the pool atomically: a concurrent watchdog recovery
         # swaps all three together, and a torn read would scatter this
         # prefill into a mixed old/new pool
@@ -1166,7 +1476,10 @@ class GenerationServer:
             fresh = plan.phys[matched:matched + n_sc]
             scatter_phys = np.zeros((n_sc,), np.int32)
             scatter_phys[:len(fresh)] = fresh
-            out = self._admit_hit_fn(sb, matched)(
+            dtb = (-(-_bucket(req.t0, self.max_len) // bs) * bs
+                   if self._spec is not None else 0)
+            extra = draft_ops(dtb) if self._spec is not None else ()
+            out = self._admit_hit_fn(sb, matched, dtb)(
                 emb_p, blk_stack, head_p, kc, vc, state,
                 jnp.asarray(padded), np.int32(p0),
                 np.int32(req.t0 - p0 - 1), np.int32(req.t0),
@@ -1175,7 +1488,8 @@ class GenerationServer:
                 np.float32(req.temperature), np.int32(req.top_k),
                 np.float32(req.top_p),
                 jnp.asarray(plan.phys[:matched], jnp.int32),
-                jnp.asarray(scatter_phys), jnp.asarray(table_row))
+                jnp.asarray(scatter_phys), jnp.asarray(table_row),
+                jnp.asarray(dtable_row), *extra)
         else:
             tb = -(-_bucket(req.t0, self.max_len) // bs) * bs
             padded = np.zeros((1, tb), np.int32)
@@ -1184,6 +1498,12 @@ class GenerationServer:
             scatter_phys = np.zeros((n_sc,), np.int32)
             head = plan.phys[:n_sc]
             scatter_phys[:len(head)] = head
+            if self._spec is not None:
+                demb_p, dblk, dhead_p, dpad, dscatter = draft_ops(tb)
+                # miss path: draft shares the target's padded prompt
+                extra = (demb_p, dblk, dhead_p, dscatter)
+            else:
+                extra = ()
             out = self._admit_miss_fn(tb)(
                 emb_p, blk_stack, head_p, kc, vc, state,
                 jnp.asarray(padded), np.int32(req.t0), np.int32(slot),
@@ -1191,7 +1511,8 @@ class GenerationServer:
                 jax.random.PRNGKey(req.seed),
                 np.float32(req.temperature), np.int32(req.top_k),
                 np.float32(req.top_p), jnp.asarray(scatter_phys),
-                jnp.asarray(table_row))
+                jnp.asarray(table_row), jnp.asarray(dtable_row),
+                *extra)
         _sanitize.mark_donated("serve/admit", kc, vc, state)
         with self._lock:
             if self._epoch != my_epoch:
@@ -1436,6 +1757,8 @@ class GenerationServer:
                         "tp": jnp.where(m, state["tp"], 1.0),
                         "table": jnp.where(m[:, None], state["table"],
                                            0),
+                        "dtable": jnp.where(m[:, None],
+                                            state["dtable"], 0),
                     }
                     n_blk_salvaged = int(bmask.sum())
                     n_blk_dropped = len(used_before
@@ -1581,7 +1904,11 @@ class GenerationServer:
                         # recovery can reconcile the allocator.
                         self._active[slot] = req
                         self._staged.add(slot)
-                        self._slot_blocks[slot] = list(plan.phys)
+                        # the DRAFT's blocks release through the same
+                        # ledger (never prefix-cached, so a retire
+                        # sends them straight back to the free list)
+                        self._slot_blocks[slot] = (list(plan.phys)
+                                                   + list(plan.dphys))
                         admits.append((req, slot, plan))
                     n_pending = len(self._pending)
                     n_active = len(self._active)
@@ -1615,10 +1942,35 @@ class GenerationServer:
                     k_drain = max(r.n_new - r.emitted for r in live)
                     sampled = any(r.temperature > 0.0 for r in live)
                 queue_busy = n_pending > 0 or not self._queue.empty()
-                k = (1 if queue_busy
-                     else min(self.tick_batch, _pow2_floor(k_drain)))
+                # speculative rounds serve ALL-GREEDY pools (the
+                # greedy acceptance rule has no rejection-sampling
+                # form here); any live sampled slot falls the whole
+                # pool back to the plain scan for those ticks —
+                # correctness is unaffected (the draft KV just goes
+                # stale, which costs later acceptance, and the
+                # verification recomputes every committed token with
+                # the target anyway)
+                use_spec = self._spec is not None and not sampled
+                if use_spec:
+                    # adaptive round count, the scan-length rule's
+                    # analogue: a single round while admission is
+                    # pending (a join waits at most one W-wide round
+                    # — bounded TTFT cost), else pow2-quantized by
+                    # the longest live budget (each round commits
+                    # >= 1 token, so R <= k_drain never runs a round
+                    # past every slot's retirement)
+                    R = (1 if queue_busy
+                         else min(self._spec.rounds,
+                                  _pow2_floor(k_drain)))
+                    k = R * (self._spec.k + 1)   # watchdog scale: the
+                    # dispatch legitimately runs ~R draft scans + R
+                    # W-wide verifications
+                else:
+                    k = (1 if queue_busy
+                         else min(self.tick_batch, _pow2_floor(k_drain)))
                 with tracer.span("serve/tick", active=n_active,
-                                 queued=n_pending, k=k):
+                                 queued=n_pending, k=k,
+                                 spec=int(use_spec)):
                     self._mark_tick(my_epoch,
                                     (my_epoch, time.monotonic(), k))
                     # chaos site: a hung dispatch — the host blocks in
@@ -1637,19 +1989,29 @@ class GenerationServer:
                                                   self._state)
                     _sanitize.check_not_donated("serve/tick", kc_in,
                                                 vc_in, state_in)
-                    kc, vc, state, toks, emitted, n_alive = \
-                        self._decode_scan(k, sampled)(
-                            emb_p, blk_stack, head_p, kc_in, vc_in,
-                            state_in)
+                    n_prop = n_acc = 0
+                    if use_spec:
+                        demb_p, dblk, dhead_p = self._draft_params
+                        (kc, vc, state, toks, emitted, n_alive,
+                         prop, acc) = self._spec_fn(R)(
+                            emb_p, blk_stack, head_p, demb_p, dblk,
+                            dhead_p, kc_in, vc_in, state_in)
+                    else:
+                        kc, vc, state, toks, emitted, n_alive = \
+                            self._decode_scan(k, sampled)(
+                                emb_p, blk_stack, head_p, kc_in, vc_in,
+                                state_in)
                     _sanitize.mark_donated("serve/tick", kc_in, vc_in,
                                            state_in)
-                    # THE host sync: one poll per k-tick scan — tokens
+                    # THE host sync: one poll per dispatch — tokens
                     # staged [B, K] device-side, per-slot live-tick
                     # counts, budgets left (all off one dispatch)
                     toks_h = np.asarray(toks)
                     emit_h = np.asarray(emitted)
                     rem_h = np.asarray(state["remaining"])
                     alive_h = int(n_alive)
+                    if use_spec:
+                        n_prop, n_acc = int(prop), int(acc)
                     _HOST_SYNCS.inc()
                     self._mark_tick(my_epoch, None)
                 # device-truth occupancy at scan end (the host view is
@@ -1666,8 +2028,27 @@ class GenerationServer:
                     _sanitize.check_finite_rows(
                         "serve/tick logits", np.asarray(state["logits"]),
                         mask, detail="slot KV cache poisoned?")
-                _TICKS.inc(k)
-                _SCANS.labels(k=str(k)).inc()
+                if use_spec:
+                    # one verification pass per round is the
+                    # expensive target "tick"; the k label marks the
+                    # dispatch shape (R rounds x W-wide verify)
+                    _TICKS.inc(R)
+                    _SCANS.labels(
+                        k=f"spec{R}x{self._spec.k + 1}").inc()
+                    if n_prop:
+                        _SPEC_PROPOSED.inc(n_prop)
+                    if n_acc:
+                        _SPEC_ACCEPTED.inc(n_acc)
+                    with self._lock:
+                        self._n_spec_proposed += n_prop
+                        self._n_spec_accepted += n_acc
+                        if self._n_spec_proposed:
+                            _SPEC_ACCEPT_RATE.set(
+                                self._n_spec_accepted
+                                / self._n_spec_proposed)
+                else:
+                    _TICKS.inc(k)
+                    _SCANS.labels(k=str(k)).inc()
                 _TOK_PER_DISPATCH.set(float(emit_h.sum()))
                 _OCC.observe(n_active / self.n_slots)
                 now_p = time.perf_counter()

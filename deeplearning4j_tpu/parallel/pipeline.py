@@ -40,12 +40,47 @@ _PIPE_STEPS = telemetry.counter(
     labelnames=("worker",))
 
 
+#: first jax release exposing top-level ``jax.shard_map`` with the
+#: ``axis_names`` (manual-axes) parameter — the API partial-auto
+#: sharding (TP auto-partitioned INSIDE pipeline stages) requires
+_SHARD_MAP_MIN_JAX = "0.6.0"
+
+
+class ShardMapPartialAutoError(NotImplementedError):
+    """Raised when a mesh needs PARTIAL-AUTO ``shard_map`` (some axes
+    manual — pipe/data — while others — 'model'/'sequence' — stay
+    GSPMD-partitioned inside the manual region) on a jax release
+    without top-level ``jax.shard_map``.
+
+    The legacy ``jax.experimental.shard_map`` fallback cannot express
+    this: its ``auto=`` form CHECK-fails in the matching jaxlib's
+    compiler (an aborted process, not a Python error), so the only
+    safe behavior is a loud refusal.  Fully-manual meshes (pure
+    DP x PP, no TP inside stages) work on either API; composing TP
+    inside pipeline stages needs jax >= ``_SHARD_MAP_MIN_JAX``.
+
+    Subclasses ``NotImplementedError`` so pre-existing callers (and
+    test skips) that caught the untyped error keep working.  Carries
+    ``auto_axes`` — the mesh axes the caller wanted auto-partitioned."""
+
+    def __init__(self, auto_axes):
+        self.auto_axes = tuple(sorted(auto_axes))
+        super().__init__(
+            f"this jax release ({jax.__version__}) has no "
+            f"jax.shard_map; the legacy fallback cannot leave axes "
+            f"{list(self.auto_axes)} auto-partitioned inside the "
+            f"manual region (TP inside pipeline stages needs jax >= "
+            f"{_SHARD_MAP_MIN_JAX})")
+
+
 def _shard_map(f, mesh: Mesh, in_specs, out_specs, manual_axes):
     """Version shim: ``jax.shard_map(..., axis_names=manual)`` on new
     jax; on older releases fall back to
     ``jax.experimental.shard_map.shard_map`` where the knob is inverted
     (``auto`` = the NON-manual axes) and replication checking cannot
-    run with auto axes present."""
+    run with auto axes present.  Partial-auto on old jax raises the
+    typed :class:`ShardMapPartialAutoError` (refusing loudly beats the
+    legacy path's compiler abort)."""
     if hasattr(jax, "shard_map"):
         return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs,
@@ -53,14 +88,7 @@ def _shard_map(f, mesh: Mesh, in_specs, out_specs, manual_axes):
     from jax.experimental.shard_map import shard_map as _legacy
     auto = frozenset(mesh.axis_names) - frozenset(manual_axes)
     if auto:
-        # legacy partial-auto (GSPMD partitioning the leftover axes
-        # INSIDE the manual region) CHECK-fails in this jaxlib's
-        # compiler — refuse loudly instead of aborting the process
-        raise NotImplementedError(
-            f"this jax release has no jax.shard_map; the legacy "
-            f"fallback cannot leave axes {sorted(auto)} auto-"
-            f"partitioned inside the manual region (TP inside "
-            f"pipeline stages needs a newer jax)")
+        raise ShardMapPartialAutoError(auto)
     return _legacy(f, mesh=mesh, in_specs=in_specs,
                    out_specs=out_specs, check_rep=False, auto=auto)
 

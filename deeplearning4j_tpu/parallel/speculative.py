@@ -1,0 +1,227 @@
+"""Speculative multi-token decode — draft construction + acceptance.
+
+The decode tick is memory-bound: every single-token dispatch streams
+the full parameter set from HBM for ONE token of math per slot
+(GENERATION_r05.json measured ~31% of the params-bandwidth ideal).
+Speculative sampling (Leviathan et al. / Chen et al., PAPERS.md)
+converts K cheap DRAFT steps plus ONE batched target-model
+verification into up to K+1 committed tokens per expensive target
+pass — the verification processes K+1 token positions at matmul rate
+(one params read amortized over the chunk) instead of K+1
+params-bandwidth-bound single-token ticks.
+
+The greedy round (``GenerationServer`` with ``speculative=``):
+
+1. **anchor** — the target's held logits already determine the next
+   token with certainty (``argmax``); no draft needed for it.
+2. **draft** — starting from the anchor, the draft model runs K
+   single-token steps through ITS OWN paged KV (the slot's ``dtable``
+   blocks — ordinary pool blocks holding the first ``draft.n_layers``
+   layers of the pool leaves), proposing tokens p_1..p_K by argmax.
+3. **verify** — ONE batched target forward over the W = K+1 tokens
+   [anchor, p_1..p_K] at positions pos..pos+K, writing target KV
+   through the slot's block table and producing target logits
+   G_0..G_K (``TransformerGenerator._verify_rows_paged``).
+4. **accept** — :func:`accept_greedy`: p_i commits iff it equals the
+   target's own argmax g_{i-1} AND every earlier proposal matched;
+   the committed count is cut at the first EOS and clamped to the
+   slot's remaining budget.  Held logits become G_{c-1}, so the NEXT
+   round's anchor is the target's correction (on a mismatch) or its
+   bonus token (on a full accept) — every committed token is the
+   argmax of target logits over the committed prefix, which is what
+   makes speculative greedy decode BYTE-IDENTICAL to non-speculative
+   decode at every acceptance pattern.  Rejected-suffix KV writes are
+   rolled back by simply not advancing ``pos`` past the commit point:
+   the slot's blocks are claimed up front at admission (the PR 7
+   contract), so rollback reuses them in place — the next round's
+   verify overwrites the rejected rows and the ``col <= pos`` mask
+   hides them meanwhile.
+
+Draft quality affects only the acceptance RATE, never correctness:
+the verify recomputes every committed token with the target model, so
+a stale or even garbage draft degrades to ~1 token per round (the
+anchor), not to wrong bytes.
+
+The default draft is a SELF-DRAFT: the target truncated to its first
+``draft_layers`` blocks, sharing the target's embedding and head
+params (:func:`make_self_draft` — zero extra weights, and layer i of
+a causal stack depends only on layers < i, so the truncation is a
+well-formed cheaper decoder).  ``draft_net=`` swaps in an
+independently trained proposer (:func:`make_draft`) whose geometry
+must fit the pool (same vocab / heads / head dim, depth <= target).
+Either way the draft's KV blocks come from the SAME pool the target's
+do — draft blocks compete in the same admission/LRU economy, an
+admission with speculation on claims roughly 2x the blocks, and a
+retiring slot drains both tables through the one allocator.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.generation import TransformerGenerator
+
+
+class DraftModel:
+    """The draft side of a speculative server: ``gen`` supplies the
+    layer math (its block conf drives ``_step_paged`` /
+    ``_prefill_rows``), ``n_layers`` is the draft depth — the slice of
+    the pool leaves its KV occupies — and :meth:`params` derives the
+    draft's (emb, stacked blocks, head) from the server's refreshed
+    target params (a self-draft slices them; an external draft
+    snapshots its own net)."""
+
+    def __init__(self, gen: TransformerGenerator, n_layers: int,
+                 params_fn):
+        self.gen = gen
+        self.n_layers = int(n_layers)
+        self._params_fn = params_fn
+
+    def params(self, target_params):
+        """(emb_p, blk_stack, head_p) for the draft, derived from the
+        target's CURRENT serving params — called from
+        ``GenerationServer.refresh_params`` so a weight refresh
+        refreshes the draft too."""
+        return self._params_fn(target_params)
+
+
+def make_self_draft(gen: TransformerGenerator,
+                    draft_layers: Optional[int] = None) -> DraftModel:
+    """Truncated-target self-draft: the first ``draft_layers`` blocks
+    of the target (default: half the stack, min 1) with the target's
+    own embedding and head.  Costs ``draft_layers / n_layers`` of a
+    target step per proposal and needs no extra weights; its params
+    are SLICES of the server's cast target params, so a
+    ``refresh_params`` refreshes both for free."""
+    n = len(gen.blocks)
+    d = max(1, n // 2) if draft_layers is None else int(draft_layers)
+    if not 1 <= d <= n:
+        raise ValueError(
+            f"draft_layers={d} out of range [1, {n}] (the self-draft "
+            "truncates the target's own stack)")
+
+    def params_fn(target_params):
+        # the target's buffers VERBATIM — the consuming programs take
+        # the [:n_layers] slice INSIDE jit (free, fused by XLA), so a
+        # self-draft really is zero extra device memory; slicing here
+        # would materialize a duplicate of the first d layers' params
+        # for the server's lifetime
+        return target_params
+
+    return DraftModel(gen, d, params_fn)
+
+
+def make_draft(gen: TransformerGenerator, draft_net) -> DraftModel:
+    """External draft model (an independently trained small decoder).
+    Geometry must fit the target's pool: same vocab (proposals index
+    target logits), same head count and head dim (draft K/V rows land
+    in the same pool leaves), and depth <= the target's (the draft
+    occupies the first ``n_layers`` pool layers)."""
+    dgen = TransformerGenerator(
+        draft_net, compute_dtype=np.dtype(gen.compute_dtype).name)
+    d = len(dgen.blocks)
+    if d > len(gen.blocks):
+        raise ValueError(
+            f"draft depth {d} exceeds the target's {len(gen.blocks)} "
+            "(draft KV lives in the first layers of the target's pool)")
+    if dgen.blocks[0].n_heads != gen.blocks[0].n_heads:
+        raise ValueError(
+            f"draft n_heads {dgen.blocks[0].n_heads} != target "
+            f"{gen.blocks[0].n_heads} (pool K/V layout is per-head)")
+    if dgen.emb.n_out != gen.emb.n_out:
+        raise ValueError(
+            f"draft d_model {dgen.emb.n_out} != target {gen.emb.n_out} "
+            "(pool K/V rows are [h, dh])")
+    v_t = int(np.shape(gen._params()[2]["W"])[-1])
+    v_d = int(np.shape(dgen._params()[2]["W"])[-1])
+    if v_d != v_t:
+        raise ValueError(f"draft vocab {v_d} != target vocab {v_t} "
+                         "(proposals must index target logits)")
+
+    def params_fn(_target_params):
+        emb_p, blk_ps, head_p = dgen._params()
+        blk_stack = dgen._stack_blocks(blk_ps)
+        if dgen.compute_dtype != jnp.float32:
+            cd = dgen.compute_dtype
+            cast = lambda t: jax.tree_util.tree_map(
+                lambda a: (a.astype(cd)
+                           if jnp.issubdtype(a.dtype, jnp.floating)
+                           else a), t)
+            emb_p, blk_stack, head_p = (cast(emb_p), cast(blk_stack),
+                                        cast(head_p))
+        return emb_p, blk_stack, head_p
+
+    return DraftModel(dgen, d, params_fn)
+
+
+class SpecConfig:
+    """Parsed ``GenerationServer(speculative={...})`` config: ``k``
+    draft proposals per round (the verification width is k+1),
+    ``rounds`` — the max rounds fused into one dispatch (the scan-
+    length analogue of ``tick_batch``; adaptive, pow2-quantized), and
+    the :class:`DraftModel`."""
+
+    def __init__(self, k: int, rounds: int, draft: DraftModel):
+        self.k = int(k)
+        self.rounds = int(rounds)
+        self.draft = draft
+        if self.k < 1:
+            raise ValueError("speculative k must be >= 1")
+        if self.rounds < 1:
+            raise ValueError("speculative rounds must be >= 1")
+
+    @classmethod
+    def build(cls, gen: TransformerGenerator,
+              spec: dict) -> "SpecConfig":
+        spec = dict(spec)
+        unknown = set(spec) - {"k", "rounds", "draft_layers",
+                               "draft_net"}
+        if unknown:
+            raise ValueError(
+                f"unknown speculative key(s) {sorted(unknown)} "
+                "(expected k / rounds / draft_layers / draft_net)")
+        draft_net = spec.get("draft_net")
+        if draft_net is not None:
+            if spec.get("draft_layers") is not None:
+                raise ValueError("draft_layers applies to the "
+                                 "self-draft; draft_net brings its "
+                                 "own depth")
+            draft = make_draft(gen, draft_net)
+        else:
+            draft = make_self_draft(gen, spec.get("draft_layers"))
+        return cls(spec.get("k", 4), spec.get("rounds", 2), draft)
+
+
+def accept_greedy(v, g, active, remaining, eos):
+    """The greedy acceptance rule on one verified chunk.
+
+    ``v`` [B, W] — the verified tokens (anchor + K proposals);
+    ``g`` [B, W] — the target's own argmax after each of them
+    (``g[:, j] = argmax(G_j)``); ``active`` [B] bool; ``remaining``
+    [B] int32 budgets; ``eos`` [B] int32 (-1 disables).
+
+    Returns ``(commit, remaining_after)``: ``commit[b]`` tokens
+    ``v[b, :commit[b]]`` are byte-identical to what non-speculative
+    greedy decode would have emitted — the anchor always commits,
+    proposal p_i commits iff it matches g_{i-1} and every earlier
+    proposal matched (one mismatch invalidates every later position's
+    context), the count is clamped to the remaining budget, and a
+    committed EOS cuts the run the way the non-speculative tick's
+    ``hit_eos`` does (``remaining_after`` drops to 0)."""
+    W = v.shape[1]
+    match = (v[:, 1:] == g[:, :-1]).astype(jnp.int32)       # [B, K]
+    a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)         # leading 1s
+    c = jnp.minimum(1 + a, remaining)
+    idx = jnp.arange(W)[None, :]
+    hit = ((v == eos[:, None]) & (eos[:, None] >= 0)
+           & (idx < c[:, None]))
+    any_hit = jnp.any(hit, axis=1)
+    first = jnp.argmax(hit, axis=1)
+    c = jnp.where(any_hit, first + 1, c)
+    rem_after = jnp.where(any_hit, 0, remaining - c)
+    c = jnp.where(active, c, 0)
+    rem_after = jnp.where(active, rem_after, remaining)
+    return c.astype(jnp.int32), rem_after.astype(jnp.int32)

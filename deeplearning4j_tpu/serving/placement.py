@@ -49,7 +49,16 @@ def replica_view(idx: int, server: GenerationServer,
     warmth = server.prefix_warmth(prompt) if prompt is not None else 0
     return {"idx": idx, "warmth": warmth,
             "free_blocks": st["free_blocks"],
-            "load": st["live_slots"] + st["queue_depth"]}
+            "load": st["live_slots"] + st["queue_depth"],
+            # speculative view (PR 11): spec_k > 0 means an admission
+            # on this replica pins ~2x blocks (target + draft tables)
+            # — the router's per-pass block-claim compensation uses
+            # it — and the acceptance rate is the replica's effective
+            # tokens-per-verification multiplier (surfaced for fleet
+            # stats/bench; deliberately NOT a ranking key, so a cold
+            # replica's 0.0 cannot fight prefix affinity)
+            "spec_k": st.get("spec_k", 0),
+            "spec_acceptance": st.get("spec_acceptance_rate", 0.0)}
 
 
 def choose_replica(views: Sequence[dict]) -> Tuple[int, str]:

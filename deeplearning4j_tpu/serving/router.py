@@ -221,7 +221,9 @@ class ServingFleet:
     how many times one request may re-place off dying replicas before
     its last failure propagates; re-placements back off with the
     resilience layer's full-jitter ``backoff_delay``.  Remaining
-    ``**server_kwargs`` construct the replicas (``n_slots``,
+    ``**server_kwargs`` construct the replicas (``speculative`` —
+    draft-verified multi-token decode, whose per-replica acceptance
+    rate surfaces through ``stats()`` — plus ``n_slots``,
     ``block_size``, ``tick_batch``, ...)."""
 
     def __init__(self, net, n_replicas: int = 2,
@@ -778,7 +780,10 @@ class ServingFleet:
                       "free_blocks": (st["free_blocks"]
                                       - extra_blocks[i]),
                       "load": (st["live_slots"] + st["queue_depth"]
-                               + extra_load[i])}
+                               + extra_load[i]),
+                      "spec_k": st.get("spec_k", 0),
+                      "spec_acceptance": st.get(
+                          "spec_acceptance_rate", 0.0)}
                      for i, st in base.items()]
             refused = set()
             status, idx = self._place(req, views, refused)
@@ -789,8 +794,13 @@ class ServingFleet:
             if status == "placed":
                 extra_load[idx] += 1
                 bs = base[idx]["block_size"]
-                extra_blocks[idx] += -(-(len(req.prompt)
-                                         + req.n_new) // bs)
+                blocks = -(-(len(req.prompt) + req.n_new) // bs)
+                if base[idx].get("spec_k", 0):
+                    # a speculative replica pins the draft's table too
+                    # — without the 2x the intra-pass compensation
+                    # under-counts and a burst piles onto the replica
+                    blocks *= 2
+                extra_blocks[idx] += blocks
                 n_dispatched += 1
             elif status == "refused":
                 self._count_queued(req)

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Speculative-decode benchmark -> SERVING_SPEC_r11.json: draft-model
+K-ahead generation with single-dispatch batched verification through
+the paged ``GenerationServer`` — accepted-tokens/s at K in {2, 4} vs
+the non-speculative ``tick_batch``-fused baseline on identical
+geometry, with the draft acceptance rate per rung and in-window byte
+parity against the baseline outputs.
+
+Acceptance bar (ISSUE 11): accepted-tokens/s exceeding the
+non-speculative tokens/s baseline on a self-draft rung, with the
+acceptance rate recorded.
+
+``--smoke`` runs the tiny CPU config (the artifact CI records —
+JAX_PLATFORMS=cpu friendly); the default geometry needs the real chip.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    smoke = "--smoke" in sys.argv[1:]
+    if not smoke:
+        import jax
+        assert jax.default_backend() == "tpu", \
+            "needs the real chip (or pass --smoke for the CPU config)"
+    from bench import bench_speculative
+
+    result = bench_speculative(smoke=smoke)
+    print(json.dumps(result))
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SERVING_SPEC_r11.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+    print("wrote", path)
+    ok = result["vs_baseline"] > 1.0 and any(
+        r["acceptance_rate"] == 1.0 for r in result["ladder"]
+        if r["draft"] == "self_full")
+    print("acceptance:", "OK" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,18 @@ PAGED_KV_SERIES = [
     'paged_route_total{path="reference"}',
 ]
 
+# Speculative-decode series (PR 11): the smoke below decodes through
+# a draft-verified server (full-depth self-draft -> acceptance is
+# exactly 1.0), so proposed/accepted and the acceptance-rate gauge
+# carry live values on the wire — and the output is byte-compared
+# against the non-speculative decode of the same prompt.
+SPEC_SERIES = [
+    "generation_server_spec_proposed_total",
+    "generation_server_spec_accepted_total",
+    "generation_server_spec_acceptance_rate",
+    'generation_server_scan_ticks_total{k="spec',
+]
+
 # Serving-fleet series (PR 9): the smoke below routes a 2-tenant
 # workload through a 2-replica ServingFleet — the repeated hot-tenant
 # prompt rides affinity to the warm replica (a real prefix hit there),
@@ -282,6 +294,38 @@ def main() -> int:
         problems.append("prefix-hit decode diverged from the cold "
                         "decode of the same prompt")
 
+    # -- speculative decode: a draft-verified server must agree with
+    # the plain server byte-for-byte AND count real proposals -------
+    spec_prop = registry.counter(
+        "generation_server_spec_proposed_total")
+    spec_acc = registry.counter(
+        "generation_server_spec_accepted_total")
+    sp0, sa0 = spec_prop.value, spec_acc.value
+    spec_prompt = np.asarray([2, 7, 1, 8, 2, 8], np.int32)
+    with GenerationServer(gpt, n_slots=2, max_len=32,
+                          tick_timeout_s=None) as gp:
+        ref_out = gp.submit(spec_prompt, n_new=6, timeout=300)
+    with GenerationServer(gpt, n_slots=2, max_len=32,
+                          tick_timeout_s=None,
+                          speculative={"k": 2, "rounds": 2,
+                                       "draft_layers": 2}) as gs3:
+        spec_out = gs3.submit(spec_prompt, n_new=6, timeout=300)
+        spec_stats = gs3.stats()
+    if not np.array_equal(spec_out, ref_out):
+        problems.append("speculative decode diverged from the "
+                        "non-speculative decode of the same prompt")
+    if spec_prop.value - sp0 < 1:
+        problems.append("speculative decode proposed no draft tokens "
+                        "(generation_server_spec_proposed_total flat)")
+    if spec_acc.value - sa0 != spec_prop.value - sp0:
+        problems.append(
+            "full-depth self-draft must accept every proposal "
+            f"(accepted {spec_acc.value - sa0} != proposed "
+            f"{spec_prop.value - sp0})")
+    if spec_stats["spec_acceptance_rate"] != 1.0:
+        problems.append("per-instance spec acceptance rate "
+                        f"{spec_stats['spec_acceptance_rate']} != 1.0")
+
     # -- serving fleet: 2 replicas x 2 tenants through the admission
     # router — the repeated hot-tenant prompt must ride affinity to
     # the warm replica and score a real prefix hit THERE -------------
@@ -370,8 +414,8 @@ def main() -> int:
         "generation_server_host_syncs_total",
         'generation_server_scan_ticks_total{k="4"}',
         "generation_server_tokens_per_dispatch",
-    ] + PAGED_KV_SERIES + FLEET_SERIES + RESILIENCE_SERIES \
-      + ANALYSIS_SERIES
+    ] + PAGED_KV_SERIES + SPEC_SERIES + FLEET_SERIES \
+      + RESILIENCE_SERIES + ANALYSIS_SERIES
     problems += missing_series(body, required)
     if lat.count - lat_before != 16:
         problems.append(

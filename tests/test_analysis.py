@@ -351,6 +351,25 @@ class TestGraphLint:
         fs = graph_lint.lint_samediff(sd)
         assert any(f.rule == "GRAPH303" for f in fs)
 
+    def test_dynamic_control_flow_reports_skip(self):
+        # while_loop/cond bodies execute outside the registry — the
+        # lint must SAY it skipped them (GRAPH307 info), not silently
+        # half-lint the graph (ROADMAP small note, closed in PR 11)
+        from deeplearning4j_tpu.autodiff.samediff import OpNode, SameDiff
+        sd, x, w, y = _mk_sd()
+        body = SameDiff.create()
+        sd.ops.append(OpNode("while_loop", [y.name], ["w_out"],
+                             {"cond": body, "body": body}))
+        sd.vars["w_out"] = sd.vars[y.name]
+        sd.outputs = ["w_out"]
+        fs = graph_lint.lint_samediff(sd, infer=False)
+        hits = [f for f in fs if f.rule == "GRAPH307"]
+        assert len(hits) == 1 and hits[0].severity == "info"
+        assert "dynamic control flow" in hits[0].message
+        assert "'body'" in hits[0].message and "'cond'" in hits[0].message
+        # no spurious GRAPH303 arity noise on the control-flow node
+        assert not any(f.rule == "GRAPH303" for f in fs)
+
     def test_f64_constant_from_python_scalar(self):
         # a TRUE POSITIVE on the real repo API: SDVariable arithmetic
         # promotes bare Python floats through _as_var/np.asarray into

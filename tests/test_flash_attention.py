@@ -330,3 +330,43 @@ def test_paged_pallas_interpret_matches_reference():
     out = _paged_decode_pallas(q, kp, vp, tbl, pos, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5)
+
+
+# -- paged multi-query verification (PR 11, speculative decode) --------
+def test_paged_verify_reference_unrolls_to_single_query():
+    """Each query row of the W-wide verification reference must be
+    BYTE-identical to the single-query decode attention at that row's
+    position — the speculative parity contract (the reference unrolls
+    per row precisely so a W-row einsum cannot regroup reductions)."""
+    from deeplearning4j_tpu.kernels import (paged_decode_attention,
+                                            paged_verify_attention)
+    from deeplearning4j_tpu.kernels.paged_attention import (
+        paged_verify_attention_reference)
+    rng = np.random.default_rng(1)
+    q1, kp, vp, tbl, pos, scale = _paged_fixture(seed=1)
+    W = 3
+    q = jnp.asarray(rng.normal(size=(3, W, 4, 8)), jnp.float32)
+    ref = paged_verify_attention_reference(q, kp, vp, tbl, pos, scale)
+    for j in range(W):
+        row = paged_decode_attention(q[:, j], kp, vp, tbl, pos + j,
+                                     scale=scale)
+        np.testing.assert_array_equal(np.asarray(ref[:, j]),
+                                      np.asarray(row))
+    out = paged_verify_attention(q, kp, vp, tbl, pos, scale=scale)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_paged_verify_pallas_interpret_matches_reference():
+    """The multi-query Pallas verification kernel (interpret mode on
+    CPU) agrees with the per-row-unrolled reference to float
+    tolerance, at chunk positions ending mid-block."""
+    from deeplearning4j_tpu.kernels.paged_attention import (
+        _paged_verify_pallas, paged_verify_attention_reference)
+    rng = np.random.default_rng(2)
+    _, kp, vp, tbl, pos, scale = _paged_fixture(seed=2)
+    W = 3
+    q = jnp.asarray(rng.normal(size=(3, W, 4, 8)), jnp.float32)
+    ref = paged_verify_attention_reference(q, kp, vp, tbl, pos, scale)
+    out = _paged_verify_pallas(q, kp, vp, tbl, pos, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)

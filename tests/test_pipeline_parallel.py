@@ -30,6 +30,28 @@ def setup(mesh):
     return blk, params, x, apply_one
 
 
+def test_partial_auto_on_old_jax_raises_typed_error():
+    """Without top-level jax.shard_map, a mesh asking for partial-auto
+    (TP left GSPMD-partitioned inside the manual pipe region) must
+    refuse with the TYPED ShardMapPartialAutoError naming the minimum
+    jax version — not the legacy path's compiler abort (ROADMAP small
+    note, closed in PR 11).  On new jax the path doesn't exist; skip."""
+    from deeplearning4j_tpu.parallel.pipeline import (
+        _SHARD_MAP_MIN_JAX, ShardMapPartialAutoError, _shard_map)
+    if hasattr(jax, "shard_map"):
+        pytest.skip("this jax has jax.shard_map (no legacy fallback)")
+    m = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+             ("pipe", "model"))
+    with pytest.raises(ShardMapPartialAutoError) as ei:
+        _shard_map(lambda a: a, m, in_specs=None, out_specs=None,
+                   manual_axes={"pipe"})
+    assert ei.value.auto_axes == ("model",)
+    assert _SHARD_MAP_MIN_JAX in str(ei.value)
+    assert "no jax.shard_map" in str(ei.value)   # the phrase the
+    # multiproc worker's skip detection greps for
+    assert isinstance(ei.value, NotImplementedError)   # old catchers
+
+
 def _sequential(params, x, apply_one, n_blocks=8):
     h = x
     for i in range(n_blocks):
