@@ -292,6 +292,14 @@ _SPEC_ACCEPT_RATE = telemetry.gauge(
     "generation_server_spec_acceptance_rate",
     "cumulative accepted/proposed draft-token ratio of the most "
     "recently dispatching speculative server")
+# Replica-side half of the request-phase family (the fleet router owns
+# the admission/placement/total phases): the SAME spans that build a
+# request's trace tree observe these series, so TTFT decomposes into
+# replica queue wait + prefill + decode on every scrape.
+_PHASE = telemetry.histogram(
+    "fleet_request_phase_seconds",
+    "per-request phase wall times (the trace spans' durations)",
+    labelnames=("phase",))
 
 
 def _pow2_floor(n: int) -> int:
@@ -335,12 +343,17 @@ class _Pending:
 
     __slots__ = ("prompt", "n_new", "eos_id", "seed", "temperature",
                  "top_k", "top_p", "t_submit", "deadline", "cancelled",
-                 "t0", "emitted", "ttft", "_result", "_error", "_event")
+                 "t0", "emitted", "ttft", "trace_id", "spans",
+                 "_t_decode", "_result", "_error", "_event")
 
     def __init__(self, prompt, n_new, eos_id, seed,
                  temperature: float = 0.0, top_k: int = 1,
                  top_p: float = 1.0,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 trace_id: Optional[str] = None):
+        self.trace_id = trace_id      # fleet-minted; None standalone
+        self.spans = {}               # phase -> open telemetry.Span
+        self._t_decode = None
         self.prompt = prompt
         self.n_new = n_new
         self.eos_id = eos_id
@@ -385,6 +398,18 @@ class _Pending:
             return False
         self.cancelled = True
         return True
+
+    def close_spans(self, outcome: str) -> None:
+        """End every phase span this request still holds (idempotent;
+        any thread).  The retire path's normal close — ALSO called by
+        the fleet router when it ABANDONS an unresolved handle on a
+        dead replica whose scheduler will never retire anything: the
+        abandoned placement's spans must flush (with the abandoning
+        outcome) instead of orphaning forever."""
+        for phase in ("queue", "prefill", "decode"):
+            sp = self.spans.pop(phase, None)
+            if sp is not None:
+                sp.end(outcome=outcome, emitted=self.emitted)
 
 
 class GenerationServer:
@@ -895,7 +920,8 @@ class GenerationServer:
                      eos_id: Optional[int] = None,
                      seed: int = 0,
                      deadline_s: Optional[float] = None,
-                     sampling: Optional[dict] = None) -> _Pending:
+                     sampling: Optional[dict] = None,
+                     trace_id: Optional[str] = None) -> _Pending:
         """Enqueue one sequence; returns a handle whose ``result()``
         blocks.  ``prompt_ids`` is a 1-D int array; the request decodes
         until ``n_new`` tokens are emitted or ``eos_id`` is sampled.
@@ -935,7 +961,14 @@ class GenerationServer:
         req = _Pending(prompt, n_new,
                        -1 if eos_id is None else int(eos_id), seed,
                        temperature=temp, top_k=tk_eff, top_p=tp_eff,
-                       deadline=deadline)
+                       deadline=deadline, trace_id=trace_id)
+        # replica-queue span: opened on the CALLER's thread, ended by
+        # the scheduler at admission (or by whatever retires a never-
+        # admitted request) — the tracked-span API exists exactly for
+        # this cross-thread close
+        args = {"trace": trace_id} if trace_id is not None else {}
+        req.spans["queue"] = telemetry.get_tracer().begin(
+            "request/replica_queue", **args)
         while True:
             try:
                 self._queue.put(req, timeout=0.1)
@@ -944,6 +977,7 @@ class GenerationServer:
                 with self._lock:
                     down = self._shutdown
                 if down:             # nobody will ever drain a slot
+                    req.close_spans("rejected")
                     raise RuntimeError(
                         "GenerationServer has been shut down") from None
         with self._lock:
@@ -1549,6 +1583,14 @@ class GenerationServer:
             dt = time.perf_counter() - req.t_submit
             if dt > 0:
                 _RATE.observe(req.emitted / dt)
+        # close every phase span the request still holds, on WHATEVER
+        # thread retires it (scheduler, watchdog recovery, shutdown) —
+        # recovered requests produce complete traces instead of
+        # orphaned never-flushed spans
+        if req._t_decode is not None and "decode" in req.spans:
+            _PHASE.labels(phase="decode").observe(
+                time.perf_counter() - req._t_decode)
+        req.close_spans("ok" if error is None else type(error).__name__)
         _RETIRED.inc()
         req._event.set()
 
@@ -1914,12 +1956,32 @@ class GenerationServer:
                     n_active = len(self._active)
                 self._retire_reaped(reaped)
                 for req, slot, plan in admits:
+                    t_adm = time.perf_counter()
+                    sp_q = req.spans.pop("queue", None)
+                    if sp_q is not None:
+                        sp_q.end(slot=slot)
+                    _PHASE.labels(phase="queue").observe(
+                        t_adm - req.t_submit)
+                    targs = ({"trace": req.trace_id}
+                             if req.trace_id is not None else {})
+                    req.spans["prefill"] = tracer.begin(
+                        "request/prefill", slot=slot,
+                        cached_blocks=plan.matched, **targs)
                     self._mark_tick(my_epoch,
                                     (my_epoch, time.monotonic(), 1))
                     admitting = slot     # a raising prefill implicates
                     committed = self._admit(req, slot, plan, my_epoch)
                     admitting = None     # only ITS slot in recovery
                     self._mark_tick(my_epoch, None)
+                    if committed:
+                        sp_p = req.spans.pop("prefill", None)
+                        if sp_p is not None:
+                            sp_p.end()
+                        req._t_decode = time.perf_counter()
+                        _PHASE.labels(phase="prefill").observe(
+                            req._t_decode - t_adm)
+                        req.spans["decode"] = tracer.begin(
+                            "request/decode", slot=slot, **targs)
                     if not committed:
                         return
                 _QDEPTH.set(n_pending + self._queue.qsize())
@@ -1968,9 +2030,14 @@ class GenerationServer:
                 else:
                     k = (1 if queue_busy
                          else min(self.tick_batch, _pow2_floor(k_drain)))
-                with tracer.span("serve/tick", active=n_active,
-                                 queued=n_pending, k=k,
-                                 spec=int(use_spec)):
+                # the tick span's owner is this scheduler INCARNATION
+                # (id, epoch), not the raw thread ident — idents of
+                # dead threads are recycled, and the watchdog must
+                # never flush an unrelated thread's spans
+                with tracer.span("serve/tick",
+                                 owner=(id(self), my_epoch),
+                                 active=n_active, queued=n_pending,
+                                 k=k, spec=int(use_spec)):
                     self._mark_tick(my_epoch,
                                     (my_epoch, time.monotonic(), k))
                     # chaos site: a hung dispatch — the host blocks in
@@ -2196,6 +2263,16 @@ class GenerationServer:
             new_epoch = self._epoch  # every commit point
             self._tick_started = None
             self._healthy.set(0)
+        # close-on-owner-death: the superseded scheduler may be hung
+        # INSIDE its tick span forever — flush its bound spans now so
+        # the trace shows the recovery instead of silently losing the
+        # dispatch (request-phase spans are unbound and stay open:
+        # salvaged requests complete their traces under the new
+        # scheduler, failed ones close at _retire).  Keyed by the
+        # superseded INCARNATION (id, epoch), never a raw thread
+        # ident — dead threads' idents are recycled.
+        telemetry.get_tracer().end_owned_by(
+            (id(self), new_epoch - 1), error="watchdog_recovery")
         _WATCHDOG_RESTARTS.inc()
         log.warning("GenerationServer watchdog: %s — salvaging "
                     "unaffected slots and restarting the scheduler",

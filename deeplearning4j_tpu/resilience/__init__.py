@@ -26,8 +26,8 @@ Every recovery event lands in the PR-1 telemetry registry:
 ``retry_{attempts,backoff_seconds}{op=}``.
 """
 from deeplearning4j_tpu.resilience.coordination import (
-    FleetCoordinator, SurvivorWorld, fleet_resume_fit,
-    survivor_rendezvous)
+    FleetCoordinator, SurvivorWorld, atomic_publish_json,
+    fleet_resume_fit, survivor_rendezvous)
 from deeplearning4j_tpu.resilience.errors import (
     CancelledError, DeadlineExceededError, ElasticWorldError,
     FleetResumeExhausted, InjectedFault, RetryableServerError,
@@ -47,6 +47,7 @@ __all__ = [
     "BadStepPolicy",
     "FleetCoordinator", "fleet_resume_fit", "survivor_rendezvous",
     "SurvivorWorld", "FleetResumeExhausted", "ElasticWorldError",
+    "atomic_publish_json",
     "PreemptionGuard", "auto_resume_fit", "request_preemption",
     "preemption_requested", "clear_preemption",
     "retry_call", "backoff_delay",

@@ -93,6 +93,28 @@ FLEET_RDV_WAIT = telemetry.histogram(
     "window, or the in-band barrier waiting for stragglers)")
 
 
+def atomic_publish_json(path: str, doc: dict) -> None:
+    """Publish ``doc`` at ``path`` atomically (write-to-temp +
+    ``os.replace``): a concurrent reader sees either the previous
+    complete document or this one, never a torn write.  The beacon
+    primitive the survivor rendezvous below writes its host files
+    with, shared with the fleet metric transport
+    (``telemetry.fleet.MetricsBeacon``) — both planes publish into a
+    shared directory that peers poll."""
+    import threading
+    path = str(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    # pid AND thread id: two threads of one process publishing the
+    # same path (a beacon loop racing a manual publish) must not
+    # interleave writes into one temp file
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
 class SurvivorWorld(NamedTuple):
     """The quorum a survivor rendezvous agreed: ``world`` processes,
     this process at ``rank`` in the deterministic (sorted-host) order,
@@ -153,11 +175,8 @@ def survivor_rendezvous(directory, host_id: Optional[str] = None,
         rdv = os.path.join(str(directory), "_rendezvous", str(epoch))
         os.makedirs(rdv, exist_ok=True)
         mine = os.path.join(rdv, host_id + ".json")
-        tmp = mine + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"host": host_id, "pid": os.getpid(),
-                       "t": time.time()}, f)
-        os.replace(tmp, mine)
+        atomic_publish_json(mine, {"host": host_id, "pid": os.getpid(),
+                                   "t": time.time()})
         my_mtime = os.path.getmtime(mine)
         world_path = os.path.join(rdv, "world.json")
 

@@ -18,12 +18,23 @@ TPU-fleet retrospective says must be designed in:
 * **lifecycle**: health-weighted dispatch, ``drain()`` for rolling
   restarts, and live migration — a dead or hard-drained replica's
   queued and in-flight requests re-place onto survivors and complete
-  byte-identical to offline ``generate()``.
+  byte-identical to offline ``generate()``;
+* **closed-loop autoscaling** (:mod:`~.autoscale`, ISSUE 12): an
+  :class:`Autoscaler` evaluates the fleet-wide metric view
+  (``telemetry.FleetRegistry``) against :class:`AutoscalePolicy` SLO
+  targets and drives ``add_replica``/``remove_replica`` with
+  hysteresis + cooldown, deferring/shedding batch-class tenants
+  before interactive ones.
 
 Telemetry rides the PR-1 registry: ``fleet_requests_total{tenant=,
 outcome=}``, ``fleet_replica_dispatch_total{replica=,reason=}``,
-``fleet_queue_wait_seconds{tenant=}``, ``fleet_replicas_healthy``.
+``fleet_queue_wait_seconds{tenant=}``, ``fleet_replicas_healthy``,
+``fleet_request_phase_seconds{phase=}`` (the request-trace phase
+decomposition), ``fleet_edf_slack_seconds{tenant=}``, and the
+``fleet_autoscale_*`` action/shed series.
 """
+from deeplearning4j_tpu.serving.autoscale import (AutoscalePolicy,
+                                                  Autoscaler)
 from deeplearning4j_tpu.serving.errors import (DeadlineInfeasibleError,
                                                FleetAdmissionError,
                                                NoHealthyReplicaError,
@@ -38,6 +49,7 @@ from deeplearning4j_tpu.serving.tenancy import (TenantAccountant,
 
 __all__ = [
     "ServingFleet", "TenantQuota", "TenantAccountant",
+    "Autoscaler", "AutoscalePolicy",
     "FleetAdmissionError", "QuotaExceededError",
     "DeadlineInfeasibleError", "NoHealthyReplicaError",
     "choose_replica", "replica_view",

@@ -1,0 +1,485 @@
+"""Closed-loop autoscaling: SLO pressure in, replica lifecycle out.
+
+ROADMAP item 3's loop, closed: PR 10 shipped the actuators
+(``ServingFleet.add_replica`` / ``remove_replica``), PR 9 the signals
+(queue-wait histograms, deadline plumbing, free-block gauges), and the
+fleet metric plane (``telemetry.fleet``) makes those signals visible
+across workers.  :class:`Autoscaler` evaluates the aggregated view on
+a scheduler-style cadence and drives the fleet:
+
+* **signals** — interactive queue-wait p99 and EDF slack p10 computed
+  over a SLIDING WINDOW (cumulative histograms are differenced
+  between evaluations — a cumulative p99 never recovers after one
+  spike, so a closed loop reading it raw would scale up forever),
+  plus the fleet queue-depth gauge, the free-KV-block gauge and the
+  healthy-replica count.  The readers are label-schema aware: against
+  an aggregated :class:`~deeplearning4j_tpu.telemetry.FleetRegistry`
+  view they consume the ``host="fleet"`` rollup children, against a
+  plain process registry the bare children — the SAME policy runs on
+  one host or a fleet;
+* **hysteresis** — scale-up needs ``up_consecutive`` consecutive
+  pressured evaluations, scale-down ``down_consecutive`` consecutive
+  idle ones, and every action arms a ``cooldown_s`` dead time:
+  flapping load changes the streak counters, not the replica count;
+* **class-aware shedding** — when pressure persists at
+  ``max_replicas`` (nothing left to scale), batch-class tenants are
+  DEFERRED first (their waiting requests demoted below interactive
+  priority via ``ServingFleet.demote_waiting``) and SHED second
+  (cancelled outright) — interactive tenants are never touched.
+
+Telemetry: ``fleet_autoscale_actions_total{direction=}``,
+``fleet_autoscale_{deferred,shed}_total{tenant=}``,
+``fleet_autoscale_replicas_target``, ``fleet_autoscale_pressure``.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from deeplearning4j_tpu import telemetry
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_ACTIONS = telemetry.counter(
+    "fleet_autoscale_actions_total",
+    "autoscaler replica actions by direction (up: add_replica, "
+    "down: remove_replica through drain->migrate)",
+    labelnames=("direction",))
+_DEFERRED = telemetry.counter(
+    "fleet_autoscale_deferred_total",
+    "batch-class waiting requests demoted below interactive priority "
+    "because pressure persisted at max_replicas",
+    labelnames=("tenant",))
+_SHED = telemetry.counter(
+    "fleet_autoscale_shed_total",
+    "batch-class waiting requests cancelled because pressure "
+    "persisted after deferral", labelnames=("tenant",))
+_TARGET = telemetry.gauge(
+    "fleet_autoscale_replicas_target",
+    "the autoscaler's current desired replica count")
+_PRESSURE = telemetry.gauge(
+    "fleet_autoscale_pressure",
+    "last evaluation: +1 scale-up pressure, -1 scale-down headroom, "
+    "0 neutral")
+
+
+class AutoscalePolicy:
+    """SLO targets + damping for one fleet (immutable config).
+
+    ``queue_wait_p99_target_s`` is the interactive admission-wait SLO
+    (windowed p99 above it is scale-up pressure);
+    ``edf_slack_p10_floor_s`` arms the deadline-headroom signal
+    (windowed slack p10 below it is pressure); ``queue_depth_high``
+    and ``free_blocks_floor`` are the direct backpressure/memory
+    triggers.  ``up_consecutive`` / ``down_consecutive`` /
+    ``cooldown_s`` are the hysteresis, ``defer_priority`` the value
+    batch-class waiting requests demote to when shedding starts."""
+
+    __slots__ = ("min_replicas", "max_replicas",
+                 "queue_wait_p99_target_s", "edf_slack_p10_floor_s",
+                 "queue_depth_high", "free_blocks_floor",
+                 "up_consecutive", "down_consecutive", "cooldown_s",
+                 "shed_batch", "defer_priority")
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 queue_wait_p99_target_s: float = 0.5,
+                 edf_slack_p10_floor_s: Optional[float] = None,
+                 queue_depth_high: Optional[int] = None,
+                 free_blocks_floor: int = 0,
+                 up_consecutive: int = 2, down_consecutive: int = 6,
+                 cooldown_s: float = 2.0, shed_batch: bool = True,
+                 defer_priority: int = 8):
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas ({self.min_replicas}) <= "
+                f"max_replicas ({self.max_replicas})")
+        self.queue_wait_p99_target_s = float(queue_wait_p99_target_s)
+        self.edf_slack_p10_floor_s = (
+            None if edf_slack_p10_floor_s is None
+            else float(edf_slack_p10_floor_s))
+        self.queue_depth_high = (None if queue_depth_high is None
+                                 else int(queue_depth_high))
+        self.free_blocks_floor = int(free_blocks_floor)
+        self.up_consecutive = max(1, int(up_consecutive))
+        self.down_consecutive = max(1, int(down_consecutive))
+        self.cooldown_s = float(cooldown_s)
+        self.shed_batch = bool(shed_batch)
+        self.defer_priority = int(defer_priority)
+
+
+def _window_quantile(uppers: Tuple[float, ...], counts: List[float],
+                     q: float) -> float:
+    """Interpolated quantile over one WINDOW's bucket counts (the
+    registry's ``percentile`` over deltas instead of cumulative
+    state).  ``counts`` includes the trailing +Inf bucket: overflow
+    samples COUNT toward the rank and resolve to the top finite bound
+    — exactly like ``_HistogramChild.percentile`` — because the worst
+    waits are precisely the ones the autoscaler must not lose (an
+    all-overflow meltdown window must read as maximal pressure, not
+    as idle).  NaN when the window is empty."""
+    total = sum(counts)
+    if total <= 0:
+        return math.nan
+    rank = q * total
+    cum = 0.0
+    lo = 0.0
+    for i, ub in enumerate(uppers):
+        prev = cum
+        cum += counts[i]
+        if cum >= rank:
+            if counts[i] == 0:
+                return ub
+            return lo + (rank - prev) / counts[i] * (ub - lo)
+        lo = ub
+    return uppers[-1] if uppers else math.nan
+
+
+class Autoscaler:
+    """Evaluate ``policy`` against a metric view on a cadence and
+    drive ``fleet``'s replica lifecycle.
+
+    >>> scaler = Autoscaler(fleet, AutoscalePolicy(max_replicas=3),
+    ...                     tenant_classes={"analytics": "batch"},
+    ...                     interval_s=0.25).start()
+    >>> ...                        # step load: replicas follow SLOs
+    >>> scaler.close()
+
+    ``source`` is where signals come from: a ``FleetRegistry``
+    (aggregated, cross-worker — the production shape), a plain
+    ``MetricsRegistry``, or None for the process-default registry.
+    ``evaluate()`` is public so tests and external schedulers can
+    drive the loop without the thread."""
+
+    def __init__(self, fleet, policy: Optional[AutoscalePolicy] = None,
+                 source=None, interval_s: float = 0.5,
+                 tenant_classes: Optional[Dict[str, str]] = None,
+                 remove_timeout_s: float = 30.0):
+        self.fleet = fleet
+        self.policy = policy or AutoscalePolicy()
+        self.source = source
+        self.interval_s = float(interval_s)
+        if self.interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        self.remove_timeout_s = float(remove_timeout_s)
+        self.tenant_classes = dict(tenant_classes or {})
+        self.batch_tenants = sorted(
+            t for t, c in self.tenant_classes.items() if c == "batch")
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._target = int(getattr(fleet, "n_replicas", 1))
+        self._added: List[int] = []    # replicas THIS loop added (LIFO
+                                       # scale-down order)
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_action = float("-inf")
+        self._deferred = False         # defer fired since pressure rose
+        self._hist_prev: Dict[str, Tuple[List[float], float]] = {}
+        _TARGET.set(self._target)
+
+    # -- signal readers ------------------------------------------------
+    def _registry(self):
+        src = self.source
+        if src is None:
+            return telemetry.get_registry()
+        view = getattr(src, "view", None)
+        if callable(view):
+            if getattr(src, "directory", None) is not None:
+                src.refresh()
+            return view()
+        return src
+
+    @staticmethod
+    def _children(fam):
+        """The children to read: against an aggregated view (a
+        ``host`` label is present) only the ``host="fleet"`` rollups —
+        per-host series would double-count; against a plain registry,
+        every child."""
+        items = fam._items()
+        if "host" in fam.labelnames:
+            hidx = fam.labelnames.index("host")
+            items = [(lv, c) for lv, c in items if lv[hidx] == "fleet"]
+        return items
+
+    def _gauge_sum(self, reg, name: str) -> Optional[float]:
+        fam = reg.get(name)
+        if fam is None or fam.kind != "gauge":
+            return None
+        items = self._children(fam)
+        if not items:
+            return None
+        return sum(c.value for _, c in items)
+
+    def _hist_window_quantile(self, reg, name: str, q: float,
+                              label: Optional[str] = None,
+                              allowed: Optional[Iterable[str]] = None,
+                              key: Optional[str] = None
+                              ) -> Optional[float]:
+        """Windowed quantile of a (possibly label-filtered) histogram
+        family: merge the selected children's cumulative buckets,
+        difference against the previous evaluation, and take the
+        quantile of the delta.  None when the family is absent or the
+        window saw no new samples.  ``key`` names the window slot
+        (one family read with two filters needs two windows)."""
+        fam = reg.get(name)
+        if fam is None or fam.kind != "histogram":
+            return None
+        aset = None if allowed is None else {str(v) for v in allowed}
+        lidx = (fam.labelnames.index(label)
+                if label is not None and label in fam.labelnames
+                else None)
+        uppers: Tuple[float, ...] = ()
+        merged: Optional[List[float]] = None
+        for lv, child in self._children(fam):
+            if aset is not None and lidx is not None \
+                    and lv[lidx] not in aset:
+                continue
+            u, counts, _s, _n = child.state()
+            if merged is None:
+                uppers = u
+                merged = [0.0] * len(counts)
+            for i, c in enumerate(counts):
+                merged[i] += c
+        if merged is None:
+            return None
+        total = sum(merged)
+        key = key or name
+        with self._lock:
+            prev = self._hist_prev.get(key)
+            self._hist_prev[key] = (list(merged), total)
+        if prev is None or total < prev[1]:
+            # first sight (fresh autoscaler on a long-lived registry)
+            # or a registry reset: PRIME the window and report no
+            # signal — reading the whole cumulative history as one
+            # window would resurrect every historical spike as
+            # current pressure, the exact failure windowing exists
+            # to avoid
+            return None
+        window = [max(0.0, c - p) for c, p in zip(merged, prev[0])]
+        if sum(window) <= 0:
+            return None
+        return _window_quantile(uppers, window, q)
+
+    def interactive_tenants(self, reg) -> Optional[List[str]]:
+        """Tenants NOT classed batch (None = no filter: every tenant
+        counts as interactive when no classes were configured)."""
+        if not self.batch_tenants:
+            return None
+        fam = reg.get("fleet_queue_wait_seconds")
+        if fam is None or "tenant" not in fam.labelnames:
+            return None
+        tidx = fam.labelnames.index("tenant")
+        seen = {lv[tidx] for lv, _ in fam._items()}
+        return sorted(seen - set(self.batch_tenants))
+
+    # -- the loop ------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> str:
+        """One control-loop pass; returns the action taken
+        ("up" / "down" / "defer" / "shed" / "hold")."""
+        now = time.monotonic() if now is None else float(now)
+        pol = self.policy
+        reg = self._registry()
+        # admission wait is TWO-STAGE: the fleet wait line (quota /
+        # no-capacity) AND the replica-internal queue the greedy
+        # dispatch pushes into — the phase="queue" histogram from the
+        # request-trace instrumentation.  SLO pressure is the worse of
+        # the two windowed p99s.
+        fleet_p99 = self._hist_window_quantile(
+            reg, "fleet_queue_wait_seconds", 0.99, label="tenant",
+            allowed=self.interactive_tenants(reg), key="fleet_wait")
+        replica_p99 = self._hist_window_quantile(
+            reg, "fleet_request_phase_seconds", 0.99, label="phase",
+            allowed=("queue",), key="replica_queue")
+        waits = [w for w in (fleet_p99, replica_p99)
+                 if w is not None and not math.isnan(w)]
+        wait_p99 = max(waits) if waits else None
+        slack_p10 = (self._hist_window_quantile(
+            reg, "fleet_edf_slack_seconds", 0.10)
+            if pol.edf_slack_p10_floor_s is not None else None)
+        qdepth = self._gauge_sum(reg, "fleet_queue_depth") or 0.0
+        free_blocks = self._gauge_sum(reg, "kv_pool_blocks_free")
+        healthy = self._gauge_sum(reg, "fleet_replicas_healthy") or 0.0
+
+        up_reasons = []
+        if (wait_p99 is not None and not math.isnan(wait_p99)
+                and wait_p99 > pol.queue_wait_p99_target_s):
+            up_reasons.append(f"queue_wait_p99={wait_p99:.3g}s")
+        if (slack_p10 is not None and not math.isnan(slack_p10)
+                and slack_p10 < pol.edf_slack_p10_floor_s):
+            up_reasons.append(f"edf_slack_p10={slack_p10:.3g}s")
+        if pol.queue_depth_high is not None \
+                and qdepth > pol.queue_depth_high:
+            up_reasons.append(f"queue_depth={qdepth:g}")
+        if pol.free_blocks_floor and free_blocks is not None \
+                and free_blocks < pol.free_blocks_floor:
+            up_reasons.append(f"free_blocks={free_blocks:g}")
+        # scale-down headroom: nothing waiting, no fresh SLO pressure,
+        # and (checked under the lock below) every targeted replica
+        # actually became healthy — never judge "idle" while a
+        # newcomer is still joining
+        idle = (not up_reasons and qdepth == 0
+                and (wait_p99 is None or math.isnan(wait_p99)
+                     or wait_p99 < 0.5 * pol.queue_wait_p99_target_s))
+
+        # re-base the desired-replica target on fleet truth: replicas
+        # that died (chaos) or were removed externally must not pin a
+        # stale target — that would both block scale-down forever
+        # (healthy can never reach it) and refuse scale-up at a
+        # phantom max while fewer replicas actually live
+        try:
+            n_live = sum(1 for r in self.fleet.stats()["replicas"]
+                         if not r["dead"] and not r["removed"])
+        except Exception:
+            n_live = None
+
+        with self._lock:
+            if n_live is not None:
+                self._target = n_live
+            down_ok = idle and healthy >= self._target
+            if up_reasons:
+                self._up_streak += 1
+                self._down_streak = 0
+            elif down_ok:
+                self._down_streak += 1
+                self._up_streak = 0
+                self._deferred = False
+            else:
+                self._up_streak = 0
+                self._down_streak = 0
+                self._deferred = False
+            _PRESSURE.set(1 if up_reasons else (-1 if down_ok else 0))
+            cooled = now - self._last_action >= pol.cooldown_s
+            action = "hold"
+            remove_idx = None
+            if (self._up_streak >= pol.up_consecutive and cooled):
+                if self._target < pol.max_replicas:
+                    action = "up"
+                    self._target += 1
+                elif pol.shed_batch and self.batch_tenants:
+                    action = "shed" if self._deferred else "defer"
+                    self._deferred = True
+                if action != "hold":
+                    self._last_action = now
+                    self._up_streak = 0
+            elif (self._down_streak >= pol.down_consecutive and cooled
+                    and self._target > pol.min_replicas):
+                action = "down"
+                self._target -= 1
+                self._last_action = now
+                self._down_streak = 0
+                remove_idx = self._added.pop() if self._added else None
+            target = self._target
+        _TARGET.set(target)
+
+        # actuate OUTSIDE the lock (replica construction compiles;
+        # remove_replica blocks on migration)
+        if action == "up":
+            try:
+                idx = self.fleet.add_replica()
+            except Exception:
+                log.exception("autoscaler: add_replica failed")
+                with self._lock:
+                    self._target -= 1
+                    target = self._target
+                _TARGET.set(target)
+                return "hold"
+            with self._lock:
+                self._added.append(idx)
+            _ACTIONS.labels(direction="up").inc()
+            log.info("autoscaler: scaled UP to %d (replica %d): %s",
+                     target, idx, ", ".join(up_reasons))
+        elif action == "down":
+            if remove_idx is not None and not self._removable(remove_idx):
+                # the loop's own add may have died or been removed
+                # externally since (chaos kill) — removing a corpse
+                # would count an action that frees no capacity
+                remove_idx = None
+            if remove_idx is None:
+                remove_idx = self._pick_removable()
+            if remove_idx is None:
+                with self._lock:
+                    self._target += 1
+                    target = self._target
+                _TARGET.set(target)
+                return "hold"
+            try:
+                self.fleet.remove_replica(remove_idx,
+                                          timeout=self.remove_timeout_s)
+            except Exception:
+                log.exception("autoscaler: remove_replica(%d) failed",
+                              remove_idx)
+            _ACTIONS.labels(direction="down").inc()
+            log.info("autoscaler: scaled DOWN to %d (removed replica "
+                     "%d)", target, remove_idx)
+        elif action == "defer":
+            for t in self.batch_tenants:
+                n = self.fleet.demote_waiting(
+                    (t,), priority=self.policy.defer_priority)
+                if n:
+                    _DEFERRED.labels(tenant=t).inc(n)
+            log.warning("autoscaler: at max_replicas under pressure "
+                        "(%s) — deferring batch tenants %s",
+                        ", ".join(up_reasons), self.batch_tenants)
+        elif action == "shed":
+            for t in self.batch_tenants:
+                n = self.fleet.demote_waiting((t,), cancel=True)
+                if n:
+                    _SHED.labels(tenant=t).inc(n)
+            log.warning("autoscaler: pressure persisted after "
+                        "deferral — shedding batch tenants %s",
+                        self.batch_tenants)
+        return action
+
+    def _removable(self, idx: int) -> bool:
+        """Is ``idx`` still a live replica worth scaling in?"""
+        st = self.fleet.stats()
+        if not 0 <= idx < len(st["replicas"]):
+            return False
+        r = st["replicas"][idx]
+        return not r["dead"] and not r["removed"]
+
+    def _pick_removable(self) -> Optional[int]:
+        """Highest-index live replica when the loop added none itself
+        (still bounded below by min_replicas at the decision site)."""
+        st = self.fleet.stats()
+        live = [i for i, r in enumerate(st["replicas"])
+                if not r["dead"] and not r["removed"]]
+        return max(live) if len(live) > 1 else None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate()
+            except Exception:
+                # the control loop must outlive one bad pass
+                log.exception("autoscaler evaluation failed")
+
+    def start(self) -> "Autoscaler":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="dl4j-tpu-autoscaler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=max(5.0, 4 * self.interval_s))
+
+    def __enter__(self) -> "Autoscaler":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    @property
+    def target(self) -> int:
+        with self._lock:
+            return self._target

@@ -61,14 +61,26 @@ terminal cancelled / expired / failed), ``fleet_replica_dispatch_
 total{replica=,reason=}`` (affinity / least_loaded / failover),
 ``fleet_queue_wait_seconds{tenant=}``, ``fleet_replicas_healthy`` and
 ``fleet_queue_depth``.
+
+Request-scoped TRACING (ISSUE 12): ``submit`` mints a trace id that
+flows admission -> placement -> replica queue -> prefill -> decode ->
+retire; every phase records a tracked span tagged ``trace=<id>``
+(``telemetry.get_tracer().events_for_trace(id)`` is one request's
+cross-component tree) and the same instrumentation observes
+``fleet_request_phase_seconds{phase=}`` — TTFT decomposed into its
+phases — plus ``fleet_edf_slack_seconds{tenant=}`` at dispatch, the
+autoscaler's pressure signal.  ``demote_waiting`` is the autoscaler's
+shed/defer actuator for batch-class tenants.
 """
 from __future__ import annotations
 
+import itertools
 import logging
+import os
 import queue
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 import numpy as np
 
@@ -114,9 +126,33 @@ _FLEET_QDEPTH = telemetry.gauge(
     "fleet_queue_depth",
     "requests waiting in the fleet router (intake + quota/capacity "
     "wait line; per-replica queues are counted by the replicas)")
+# Request-phase decomposition (ISSUE 12): the SAME instrumentation
+# that records each request's trace spans observes this family, so
+# TTFT stops being one opaque number — admission wait, placement,
+# replica queue, prefill and decode each carry their own series.
+_PHASE = telemetry.histogram(
+    "fleet_request_phase_seconds",
+    "per-request phase wall times (the trace spans' durations): "
+    "admission (submit -> first dispatch), placement (candidate "
+    "ranking + replica handoff), total (submit -> retire); the "
+    "replica-side queue/prefill/decode phases come from the decode "
+    "server's half of the same family", labelnames=("phase",))
+_EDF_SLACK = telemetry.histogram(
+    "fleet_edf_slack_seconds",
+    "remaining deadline budget at dispatch, per tenant — the EDF "
+    "slack whose low percentiles collapsing toward 0 are the "
+    "autoscaler's scale-up pressure", labelnames=("tenant",))
 
 #: intake sentinel that wakes the scheduler without meaning "stop"
 _WAKE = object()
+
+#: process-unique request trace ids (the pid makes them fleet-unique
+#: across workers beaconing into one shared trace store)
+_TRACE_SEQ = itertools.count()
+
+
+def _mint_trace_id() -> str:
+    return f"req-{os.getpid():x}-{next(_TRACE_SEQ):x}"
 
 
 class _FleetRequest:
@@ -129,12 +165,15 @@ class _FleetRequest:
     __slots__ = ("prompt", "n_new", "eos_id", "seed", "sampling",
                  "tenant", "priority", "cost", "deadline", "t_submit",
                  "t_submit_m", "cancelled", "migrations", "replica",
-                 "inner", "ttft", "_t_dispatch", "_not_before",
-                 "_migrate", "_quota_held", "_queued_counted",
-                 "_migrating", "_result", "_error", "_event")
+                 "inner", "ttft", "trace_id", "spans", "_t_dispatch",
+                 "_not_before", "_migrate", "_quota_held",
+                 "_queued_counted", "_migrating", "_result", "_error",
+                 "_event")
 
     def __init__(self, prompt, n_new, eos_id, seed, sampling, tenant,
                  priority, cost, deadline):
+        self.trace_id = _mint_trace_id()
+        self.spans = {}               # phase -> open telemetry.Span
         self.prompt = prompt
         self.n_new = n_new
         self.eos_id = eos_id
@@ -323,6 +362,17 @@ class ServingFleet:
                             None if eos_id is None else int(eos_id),
                             int(seed), sampling, tenant, int(priority),
                             cost, deadline)
+        # the request's trace is born HERE: a root span covering the
+        # whole fleet residence plus the admission phase, both tagged
+        # with the minted trace id every later component (placement,
+        # replica queue/prefill/decode) stamps its own spans with —
+        # one submit -> retire tree per request in the trace viewer
+        tracer = telemetry.get_tracer()
+        req.spans["request"] = tracer.begin(
+            "request", trace=req.trace_id, tenant=tenant,
+            n_new=n_new, priority=int(priority))
+        req.spans["admission"] = tracer.begin(
+            "request/admission", trace=req.trace_id, tenant=tenant)
         while True:
             try:
                 self._intake.put(req, timeout=0.1)
@@ -332,6 +382,10 @@ class ServingFleet:
                     down = self._shutdown
                 if down:
                     self._acct.drop_queued(tenant)
+                    for sp in (req.spans.pop(p, None)
+                               for p in ("admission", "request")):
+                        if sp is not None:
+                            sp.end(outcome="rejected")
                     raise RuntimeError(
                         "ServingFleet has been shut down") from None
         with self._lock:
@@ -459,6 +513,41 @@ class ServingFleet:
             log.exception("removed replica %d shutdown failed", idx)
         self._wake()
 
+    def demote_waiting(self, tenants: Iterable[str],
+                       priority: Optional[int] = None,
+                       cancel: bool = False) -> int:
+        """Load-shedding hooks for the autoscaler's batch-before-
+        interactive policy, applied to the WAIT LINE only (in-flight
+        work is never touched):
+
+        * ``priority=N`` DEFERS: every waiting request of the named
+          tenants whose priority is better (lower) than ``N`` is
+          demoted to ``N``, so interactive traffic dispatches first
+          while the batch work keeps its place in line;
+        * ``cancel=True`` SHEDS: the named tenants' waiting requests
+          are cancelled outright (their callers see
+          ``CancelledError``; quota charges are refunded by the
+          normal cancel accounting).
+
+        Returns how many requests were demoted/cancelled."""
+        tenants = {str(t) for t in tenants}
+        hit: List[_FleetRequest] = []
+        with self._lock:
+            for req in self._waiting:
+                if req.tenant not in tenants:
+                    continue
+                if cancel:
+                    hit.append(req)
+                elif priority is not None and req.priority < int(priority):
+                    req.priority = int(priority)
+                    hit.append(req)
+        if cancel:
+            for req in hit:
+                req.cancel()
+            if hit:
+                self._wake()
+        return len(hit)
+
     def stats(self) -> dict:
         """Fleet snapshot: per-replica ``GenerationServer.stats()``
         (plus fleet-side ``dead``/``draining``/``joining``/``removed``
@@ -573,6 +662,10 @@ class ServingFleet:
                 return
             if isinstance(item, _FleetRequest):
                 self._acct.drop_queued(item.tenant)
+                for sp in (item.spans.pop(p, None)
+                           for p in ("admission", "request")):
+                    if sp is not None:
+                        sp.end(outcome="failed")
                 item._error = err
                 item._event.set()
 
@@ -585,10 +678,15 @@ class ServingFleet:
             inner = req.inner
             if inner is not None:
                 inner.cancel()
+                inner.close_spans("failed")
             if req._quota_held:
                 self._acct.release(req.tenant)
             else:
                 self._acct.drop_queued(req.tenant)
+            for sp in (req.spans.pop(p, None)
+                       for p in ("admission", "request")):
+                if sp is not None:
+                    sp.end(outcome="failed")
             req._error = err
             req._event.set()
         _FLEET_QDEPTH.set(self._intake.qsize())
@@ -615,6 +713,17 @@ class ServingFleet:
             if (req._t_dispatch is not None and inner is not None
                     and inner.ttft is not None):
                 req.ttft = (req._t_dispatch - req.t_submit) + inner.ttft
+        # close the request's remaining trace spans (root span
+        # included) wherever this runs — the scheduler thread normally,
+        # but also shutdown/teardown paths; cross-thread end is what
+        # the tracked-span API exists for
+        final = outcome or ("ok" if error is None else "error")
+        for sp in (req.spans.pop(p, None)
+                   for p in ("admission", "request")):
+            if sp is not None:
+                sp.end(outcome=final)
+        _PHASE.labels(phase="total").observe(
+            time.perf_counter() - req.t_submit)
         req._event.set()
 
     # -- scheduler passes (scheduler thread only) ----------------------
@@ -816,6 +925,10 @@ class ServingFleet:
         candidate refused, or ``("failed", None)`` when the request
         terminally failed."""
         views = list(views)
+        sp_place = telemetry.get_tracer().begin(
+            "request/placement", trace=req.trace_id,
+            candidates=len(views))
+        t_place = time.perf_counter()
         while views:
             idx, reason = choose_replica(views)
             if req._migrating:
@@ -828,7 +941,7 @@ class ServingFleet:
                 inner = srv.submit_async(
                     req.prompt, req.n_new, eos_id=req.eos_id,
                     seed=req.seed, deadline_s=remaining,
-                    sampling=req.sampling)
+                    sampling=req.sampling, trace_id=req.trace_id)
             except RuntimeError:
                 # raced into a draining/shutdown replica: drop it from
                 # the candidate ranking and try the next one
@@ -837,6 +950,7 @@ class ServingFleet:
                 views = [v for v in views if v["idx"] != idx]
                 continue
             except Exception as e:
+                sp_place.end(outcome="failed")
                 with self._lock:
                     if req in self._waiting:
                         self._waiting.remove(req)
@@ -851,9 +965,22 @@ class ServingFleet:
                 self._inflight.append(req)
             first = req._t_dispatch is None
             req._t_dispatch = time.perf_counter()
+            sp_place.end(replica=idx, reason=reason)
+            _PHASE.labels(phase="placement").observe(
+                req._t_dispatch - t_place)
             if first:
-                _QWAIT.labels(tenant=req.tenant).observe(
-                    req._t_dispatch - req.t_submit)
+                wait = req._t_dispatch - req.t_submit
+                _QWAIT.labels(tenant=req.tenant).observe(wait)
+                _PHASE.labels(phase="admission").observe(wait)
+                sp_adm = req.spans.pop("admission", None)
+                if sp_adm is not None:
+                    sp_adm.end(replica=idx)
+            if req.deadline is not None:
+                # EDF slack at dispatch: the SLO headroom the fleet
+                # still has for this request — the autoscaler's
+                # earliest-collapsing pressure signal
+                _EDF_SLACK.labels(tenant=req.tenant).observe(
+                    max(0.0, req.deadline - time.monotonic()))
             _DISPATCH.labels(replica=str(idx), reason=reason).inc()
             if req._migrating:
                 req._migrating = False
@@ -865,6 +992,7 @@ class ServingFleet:
             if req.cancelled:
                 inner.cancel()       # raced a cancel mid-placement
             return "placed", idx
+        sp_place.end(outcome="refused")
         return "refused", None       # every candidate refused
 
     def _completion_pass(self, now: float) -> int:
@@ -935,6 +1063,12 @@ class ServingFleet:
 
     def _remove_and_finish(self, req: _FleetRequest, err,
                            outcome: str) -> None:
+        inner = req.inner
+        if inner is not None:
+            # terminal abandon paths included: a dying replica's
+            # unresolved handle still flushes its spans (idempotent
+            # when the replica retired it first)
+            inner.close_spans(outcome)
         with self._lock:
             if req in self._inflight:
                 self._inflight.remove(req)
@@ -970,6 +1104,12 @@ class ServingFleet:
         req.migrations += 1
         delay = backoff_delay(req.migrations - 1,
                               self.retry_backoff_s, 1.0)
+        inner = req.inner
+        if inner is not None:
+            # the abandoned placement's replica-side spans must flush
+            # NOW: a dead replica's scheduler will never retire them
+            # (idempotent no-op when the replica did retire first)
+            inner.close_spans("abandoned")
         with self._lock:
             if req in self._inflight:
                 self._inflight.remove(req)

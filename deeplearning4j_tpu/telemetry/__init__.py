@@ -8,8 +8,15 @@ pieces, all stdlib-only:
   Prometheus text exposition, jsonl snapshots, and driver-side
   snapshot merging;
 * ``tracing``   — nestable host-side spans exported as Chrome-trace
-  jsonl (``about://tracing``/Perfetto-loadable);
-* ``exposition``— stdlib ``http.server`` scrape endpoint.
+  jsonl (``about://tracing``/Perfetto-loadable), plus TRACKED spans
+  (``begin``/``end`` from any thread, close-on-owner-death) carrying
+  request trace ids across components;
+* ``exposition``— stdlib ``http.server`` scrape endpoint;
+* ``fleet``     — the cross-worker plane (ISSUE 12): per-host metric
+  beacons pushed into a shared dir (or over ``jax.distributed``
+  collectives) and ``FleetRegistry`` aggregation into ONE
+  ``{host=}``-tagged scrape with rollups, reset detection and
+  staleness marking.
 
 Instrumented in-tree: ``optimize.fit_loop`` (step/data-wait split,
 iteration/epoch/example counters), ``parallel.trainer`` and
@@ -31,11 +38,13 @@ from typing import Optional, Sequence
 
 from deeplearning4j_tpu.telemetry.registry import (
     DEFAULT_BUCKETS, RATIO_BUCKETS, Counter, Gauge, Histogram,
-    MetricsRegistry)
-from deeplearning4j_tpu.telemetry.tracing import SpanTracer
+    MetricsRegistry, parse_series)
+from deeplearning4j_tpu.telemetry.tracing import Span, SpanTracer
 from deeplearning4j_tpu.telemetry.exposition import (
     MetricsServer, start_metrics_server)
 from deeplearning4j_tpu.telemetry.listener import TelemetryListener
+from deeplearning4j_tpu.telemetry.fleet import (
+    FleetRegistry, MetricsBeacon, exchange_snapshots, publish_beacon)
 
 _REGISTRY = MetricsRegistry()
 _TRACER = SpanTracer()
@@ -74,7 +83,9 @@ def span(name: str, **args):
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "SpanTracer",
-    "MetricsServer", "start_metrics_server", "TelemetryListener",
+    "Span", "MetricsServer", "start_metrics_server", "TelemetryListener",
+    "FleetRegistry", "MetricsBeacon", "publish_beacon",
+    "exchange_snapshots", "parse_series",
     "DEFAULT_BUCKETS", "RATIO_BUCKETS",
     "get_registry", "get_tracer", "counter", "gauge", "histogram", "span",
 ]

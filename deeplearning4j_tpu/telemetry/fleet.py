@@ -1,0 +1,510 @@
+"""Fleet-wide metric plane: push-style beacons + one aggregated scrape.
+
+Every series the stack emits is host-local — PR 9's router gauges,
+PR 10's elastic-resume counters, PR 11's speculative acceptance rates
+each live in the registry of the worker that emitted them, so no
+controller (human or autoscaler) can see the fleet.  The TensorFlow
+system paper and the TPU-generations study both make the point that
+cross-worker visibility is the PREREQUISITE for resilience and
+utilization at production scale, not an afterthought.  This module is
+that plane, in three transport-agnostic pieces:
+
+* **push transport** — :class:`MetricsBeacon`: a daemon thread that
+  periodically serializes its registry's ``snapshot()`` into a
+  per-host beacon file under ``<shared_dir>/_telemetry/`` with the
+  SAME atomic-publish machinery the survivor rendezvous beacons use
+  (``resilience.atomic_publish_json`` — a reader sees a previous
+  complete snapshot or this one, never a torn write).  Where a
+  ``jax.distributed`` mesh exists, :func:`exchange_snapshots` moves
+  the same snapshots over a control collective instead of the
+  filesystem (one padded-bytes allgather);
+
+* **aggregation** — :class:`FleetRegistry`: merges N hosts' snapshots
+  into ONE scrape-able view.  Counters and histograms are folded as
+  MONOTONIC DELTAS per host (a worker that restarts mid-window resets
+  its totals; a snapshot whose totals DECREASED is treated as a fresh
+  epoch and re-counted from zero — never subtracted as a negative
+  delta, the bug that silently corrupts count/sum consistency in
+  naive merge-by-subtraction), gauges are last-write per host.  The
+  built view tags every series ``{host=}``, adds fleet-level rollups
+  (``host="fleet"``: counters/histograms summed — merged-bucket
+  quantiles fall out of the histogram children — gauges summed, plus
+  ``host="fleet_max"`` for peak-style gauges), and STALENESS-MARKS
+  hosts whose beacon aged past ``stale_after_s``
+  (``fleet_host_up{host=} == 0``; stale gauges leave the rollups,
+  monotonic counters stay — a dead host's work happened);
+
+* **exposition** — a :class:`FleetRegistry` quacks like a registry to
+  ``telemetry.MetricsServer`` (``render_prometheus()`` refreshes from
+  the beacon directory then renders), so the fleet view is one more
+  ``/metrics`` endpoint any Prometheus can scrape.
+
+The closed-loop consumer is ``serving.autoscale.Autoscaler``, which
+evaluates this aggregated view against SLO targets and drives the
+PR 10 ``add_replica``/``remove_replica`` actuators.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.telemetry.registry import (MetricsRegistry,
+                                                   _escape_label,
+                                                   parse_series)
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: subdirectory of the shared dir the metric beacons publish into
+#: (namespaced beside — never inside — the rendezvous' ``_rendezvous``)
+BEACON_DIRNAME = "_telemetry"
+
+
+def _default_host_id() -> str:
+    return f"{os.uname().nodename}-{os.getpid()}"
+
+
+def _fmt_series(name: str, pairs: Tuple[Tuple[str, str], ...]) -> str:
+    """Re-emit a ``(name, ((k, v), ...))`` pair as the quoted series
+    grammar ``parse_series`` inverts (escaping included)."""
+    if not pairs:
+        return name
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in pairs)
+    return name + "{" + inner + "}"
+
+
+def beacon_path(directory, host: str) -> str:
+    return os.path.join(str(directory), BEACON_DIRNAME,
+                        f"{host}.json")
+
+
+def publish_beacon(directory, host: Optional[str] = None,
+                   registry: Optional[MetricsRegistry] = None,
+                   snapshot: Optional[dict] = None) -> str:
+    """Serialize one registry snapshot into this host's beacon file
+    (atomic publish).  Returns the beacon path.  The one-shot form of
+    what :class:`MetricsBeacon` does on a cadence."""
+    from deeplearning4j_tpu.resilience.coordination import (
+        atomic_publish_json)
+    if host is None:
+        host = _default_host_id()
+    host = str(host)
+    if os.sep in host:
+        raise ValueError(f"host {host!r} must be a plain name")
+    if snapshot is None:
+        if registry is None:
+            from deeplearning4j_tpu import telemetry
+            registry = telemetry.get_registry()
+        snapshot = registry.snapshot()
+    path = beacon_path(directory, host)
+    atomic_publish_json(path, {"host": host, "pid": os.getpid(),
+                               "t": time.time(),
+                               "snapshot": snapshot})
+    return path
+
+
+class MetricsBeacon:
+    """Push this worker's registry to the shared dir every
+    ``interval_s`` seconds (daemon thread), plus once at ``close()``
+    so the final counter totals always land.
+
+    >>> beacon = MetricsBeacon(shared_dir, host="host000").start()
+    >>> ...                       # train / serve; snapshots flow
+    >>> beacon.close()            # final publish + stop
+
+    The beacon counts its own publishes
+    (``fleet_beacon_publishes_total`` in the SOURCE registry), so the
+    transport is visible in the very snapshots it ships."""
+
+    def __init__(self, directory, host: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 interval_s: float = 2.0):
+        self.directory = str(directory)
+        self.host = str(host) if host is not None else _default_host_id()
+        if os.sep in self.host:
+            raise ValueError(f"host {self.host!r} must be a plain name")
+        if registry is None:
+            from deeplearning4j_tpu import telemetry
+            registry = telemetry.get_registry()
+        self.registry = registry
+        self.interval_s = float(interval_s)
+        if self.interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        self._publishes = registry.counter(
+            "fleet_beacon_publishes_total",
+            "metric-beacon snapshots this worker published into the "
+            "shared directory (the push transport's own heartbeat)")
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def publish(self) -> str:
+        """One immediate publish (also what the loop calls)."""
+        path = publish_beacon(self.directory, self.host, self.registry)
+        self._publishes.inc()
+        return path
+
+    def _publish_loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.publish()
+            except OSError:      # shared dir flake: retry next tick
+                log.exception("MetricsBeacon publish failed; retrying "
+                              "at the next interval")
+
+    def start(self) -> "MetricsBeacon":
+        self.publish()           # first beacon lands immediately
+        thread = threading.Thread(target=self._publish_loop,
+                                  name="dl4j-tpu-metrics-beacon",
+                                  daemon=True)
+        with self._lock:
+            self._thread = thread
+        thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+        try:
+            self.publish()       # final totals always land
+        except OSError:
+            log.exception("MetricsBeacon final publish failed")
+
+    def __enter__(self) -> "MetricsBeacon":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+class _HostState:
+    """One host's fold state (mutated only under the aggregator's
+    lock): accumulated monotonic totals, the last RAW snapshot for
+    delta/reset detection, gauge last-writes, and liveness."""
+
+    __slots__ = ("counters", "hists", "gauges", "last_counters",
+                 "last_hists", "last_seen", "last_t", "resets")
+
+    def __init__(self):
+        self.counters: Dict[str, float] = {}
+        self.hists: Dict[str, dict] = {}
+        self.gauges: Dict[str, float] = {}
+        self.last_counters: Dict[str, float] = {}
+        self.last_hists: Dict[str, dict] = {}
+        self.last_seen = 0.0          # aggregator-clock receive time
+        self.last_t = 0.0             # publisher's snapshot timestamp
+        self.resets = 0
+
+
+class FleetRegistry:
+    """Merge N workers' snapshots into one scrape-able fleet view.
+
+    >>> fr = FleetRegistry(shared_dir)        # file-beacon transport
+    >>> fr.refresh()                          # poll the beacon dir
+    >>> body = fr.render_prometheus()         # {host=}-tagged + rollups
+    >>> view = fr.view()                      # a real MetricsRegistry
+    >>> view.get("fleet_queue_wait_seconds").labels(
+    ...     tenant="hot", host="fleet").percentile(0.99)
+
+    ``ingest(host, snapshot)`` is the transport-agnostic entry — the
+    directory poll and the collective exchange both end there.
+    Counter/histogram RESETS (worker restart mid-window) are detected
+    per series: a total that decreased starts a fresh epoch and folds
+    in wholesale instead of as a negative delta."""
+
+    def __init__(self, directory=None, stale_after_s: float = 10.0):
+        self.directory = str(directory) if directory is not None else None
+        self.stale_after_s = float(stale_after_s)
+        self._lock = threading.Lock()
+        self._hosts: Dict[str, _HostState] = {}
+
+    # -- fold ----------------------------------------------------------
+    def ingest(self, host: str, snapshot: dict,
+               now: Optional[float] = None) -> None:
+        """Fold one host's ``MetricsRegistry.snapshot()`` in.  Safe to
+        call with the SAME snapshot repeatedly (deltas are zero) and
+        with post-restart snapshots (reset detection)."""
+        now = time.monotonic() if now is None else float(now)
+        host = str(host)
+        with self._lock:
+            st = self._hosts.get(host)
+            if st is None:
+                st = self._hosts[host] = _HostState()
+            self._fold_counters_locked(st, snapshot.get("counters", {}))
+            self._fold_hists_locked(st, snapshot.get("histograms", {}))
+            st.gauges = dict(snapshot.get("gauges", {}))
+            st.last_seen = now
+            st.last_t = float(snapshot.get("timestamp", 0.0))
+
+    def _fold_counters_locked(self, st: _HostState,
+                              raw: Dict[str, float]) -> None:
+        for series, v in raw.items():
+            v = float(v)
+            prev = st.last_counters.get(series)
+            if prev is None:
+                delta = v
+            elif v < prev - 1e-9:
+                # RESET: the worker restarted mid-window — its totals
+                # began again from zero.  Treat the snapshot as a
+                # fresh epoch and fold it in wholesale; subtracting
+                # would produce a negative delta and silently shrink
+                # the fleet total.
+                delta = v
+                st.resets += 1
+            else:
+                delta = v - prev
+            st.counters[series] = st.counters.get(series, 0.0) + delta
+            st.last_counters[series] = v
+
+    def _fold_hists_locked(self, st: _HostState,
+                           raw: Dict[str, dict]) -> None:
+        for series, h in raw.items():
+            buckets = {u: float(c)
+                       for u, c in h.get("buckets", {}).items()}
+            cur = {"buckets": buckets, "inf": float(h.get("inf", 0)),
+                   "sum": float(h.get("sum", 0.0)),
+                   "count": float(h.get("count", 0))}
+            prev = st.last_hists.get(series)
+            acc = st.hists.get(series)
+            if acc is None:
+                acc = st.hists[series] = {
+                    "buckets": {u: 0.0 for u in buckets},
+                    "inf": 0.0, "sum": 0.0, "count": 0.0}
+            if prev is None or cur["count"] < prev["count"] - 1e-9:
+                # first sight, or a reset epoch: fold in wholesale
+                # (count going BACKWARD can only mean the worker's
+                # histogram began again — bucket-wise subtraction
+                # would go negative and desync count vs sum)
+                if prev is not None:
+                    st.resets += 1
+                delta = cur
+            else:
+                delta = {
+                    "buckets": {
+                        u: max(0.0, c - prev["buckets"].get(u, 0.0))
+                        for u, c in buckets.items()},
+                    "inf": max(0.0, cur["inf"] - prev["inf"]),
+                    "sum": max(0.0, cur["sum"] - prev["sum"]),
+                    "count": cur["count"] - prev["count"]}
+            for u, c in delta["buckets"].items():
+                acc["buckets"][u] = acc["buckets"].get(u, 0.0) + c
+            acc["inf"] += delta["inf"]
+            acc["sum"] += delta["sum"]
+            acc["count"] += delta["count"]
+            st.last_hists[series] = cur
+
+    # -- transports ----------------------------------------------------
+    def refresh(self, now: Optional[float] = None) -> List[str]:
+        """Poll the beacon directory and ingest every host file;
+        returns the hosts seen this pass.  Unreadable/torn files are
+        skipped (the atomic publish makes them transient)."""
+        if self.directory is None:
+            raise ValueError("FleetRegistry was built without a beacon "
+                             "directory; feed it via ingest()")
+        bdir = os.path.join(self.directory, BEACON_DIRNAME)
+        seen: List[str] = []
+        try:
+            names = sorted(os.listdir(bdir))
+        except OSError:
+            return seen
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(bdir, name)) as f:
+                    doc = json.load(f)
+                host = str(doc["host"])
+                snap = doc["snapshot"]
+            except (OSError, ValueError, KeyError):
+                continue          # mid-replace or foreign file
+            self.ingest(host, snap, now=now)
+            seen.append(host)
+        return seen
+
+    # -- view ----------------------------------------------------------
+    def hosts(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """Liveness ledger: ``{host: {stale, age_s, resets}}``."""
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            return {h: {"stale": (now - st.last_seen
+                                  > self.stale_after_s),
+                        "age_s": max(0.0, now - st.last_seen),
+                        "resets": st.resets}
+                    for h, st in self._hosts.items()}
+
+    def view(self, now: Optional[float] = None) -> MetricsRegistry:
+        """Build the aggregated registry: every host's series tagged
+        ``{host=}``, plus ``host="fleet"`` rollups (counters and
+        histograms summed across ALL hosts — monotonic work done is
+        never un-counted; gauges summed across LIVE hosts only, with
+        ``host="fleet_max"`` as the peak rollup) and the liveness
+        meta-series."""
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            hosts = {h: (st.counters.copy(),
+                         {s: {"buckets": a["buckets"].copy(),
+                              "inf": a["inf"], "sum": a["sum"],
+                              "count": a["count"]}
+                          for s, a in st.hists.items()},
+                         st.gauges.copy(),
+                         now - st.last_seen > self.stale_after_s,
+                         now - st.last_seen, st.resets)
+                     for h, st in self._hosts.items()}
+        view = MetricsRegistry()
+        roll_c: Dict[str, float] = {}
+        roll_h: Dict[str, dict] = {}
+        roll_g_sum: Dict[str, float] = {}
+        roll_g_max: Dict[str, float] = {}
+        n_stale = 0
+        up = view.gauge(
+            "fleet_host_up",
+            "1 while the host's beacon is fresher than stale_after_s; "
+            "0 marks a stale host (its gauges leave the rollups)",
+            labelnames=("host",))
+        age = view.gauge(
+            "fleet_beacon_age_seconds",
+            "seconds since this host's beacon was last ingested",
+            labelnames=("host",))
+        resets = view.counter(
+            "fleet_counter_resets_total",
+            "snapshots whose totals DECREASED vs the previous one "
+            "(worker restart mid-window) — folded as fresh epochs, "
+            "never as negative deltas", labelnames=("host",))
+        for h in sorted(hosts):
+            counters, hists, gauges, stale, age_s, n_resets = hosts[h]
+            up.labels(host=h).set(0.0 if stale else 1.0)
+            age.labels(host=h).set(age_s)
+            resets.labels(host=h).inc(n_resets)
+            n_stale += bool(stale)
+            snap = {"counters": {}, "gauges": {}, "histograms": {}}
+            for series, v in counters.items():
+                name, pairs = parse_series(series)
+                snap["counters"][
+                    _fmt_series(name, pairs + (("host", h),))] = v
+                roll_c[series] = roll_c.get(series, 0.0) + v
+            for series, a in hists.items():
+                name, pairs = parse_series(series)
+                snap["histograms"][
+                    _fmt_series(name, pairs + (("host", h),))] = a
+                r = roll_h.get(series)
+                if r is None:
+                    r = roll_h[series] = {"buckets": {}, "inf": 0.0,
+                                          "sum": 0.0, "count": 0.0}
+                for u, c in a["buckets"].items():
+                    r["buckets"][u] = r["buckets"].get(u, 0.0) + c
+                r["inf"] += a["inf"]
+                r["sum"] += a["sum"]
+                r["count"] += a["count"]
+            for series, v in gauges.items():
+                name, pairs = parse_series(series)
+                snap["gauges"][
+                    _fmt_series(name, pairs + (("host", h),))] = v
+                if not stale:
+                    roll_g_sum[series] = roll_g_sum.get(series, 0.0) + v
+                    roll_g_max[series] = max(
+                        roll_g_max.get(series, float("-inf")), v)
+            self._merge_defensive(view, snap)
+        roll = {"counters": {
+                    _fmt_series(*_with_host(s, "fleet")): v
+                    for s, v in roll_c.items()},
+                "histograms": {
+                    _fmt_series(*_with_host(s, "fleet")): a
+                    for s, a in roll_h.items()},
+                "gauges": {}}
+        for s, v in roll_g_sum.items():
+            roll["gauges"][_fmt_series(*_with_host(s, "fleet"))] = v
+        for s, v in roll_g_max.items():
+            roll["gauges"][_fmt_series(*_with_host(s, "fleet_max"))] = v
+        self._merge_defensive(view, roll)
+        view.gauge(
+            "fleet_hosts_live",
+            "hosts whose beacon is fresher than stale_after_s").set(
+                len(hosts) - n_stale)
+        view.gauge(
+            "fleet_hosts_stale",
+            "hosts whose beacon aged out (their gauges left the "
+            "rollups; their counters remain)").set(n_stale)
+        return view
+
+    @staticmethod
+    def _merge_defensive(view: MetricsRegistry, snap: dict) -> None:
+        """One host's labeled-series snapshot into the view, merged
+        SERIES BY SERIES: a cross-host label-schema conflict (two
+        workers registered the same family with different labels)
+        must cost exactly the offending series, not the whole scrape
+        — and a bulk merge that raised midway would have already
+        half-applied (double-counting everything a retry re-adds)."""
+        for kind in ("counters", "gauges", "histograms"):
+            for series, v in snap.get(kind, {}).items():
+                try:
+                    view.merge_snapshot({kind: {series: v}})
+                except ValueError:
+                    view.counter(
+                        "fleet_aggregate_conflicts_total",
+                        "series dropped from the fleet view because "
+                        "hosts disagree on a family's label schema"
+                    ).inc()
+                    log.warning("fleet aggregation: dropped "
+                                "conflicting series %s", series)
+
+    def render_prometheus(self) -> str:
+        """Refresh (when directory-backed) and render the aggregated
+        view — the method ``telemetry.MetricsServer`` calls, so a
+        ``FleetRegistry`` can be served directly as a fleet-wide
+        ``/metrics`` endpoint that re-aggregates per scrape."""
+        if self.directory is not None:
+            self.refresh()
+        return self.view().render_prometheus()
+
+
+def _with_host(series: str, host: str):
+    name, pairs = parse_series(series)
+    return name, pairs + (("host", host),)
+
+
+def exchange_snapshots(registry: Optional[MetricsRegistry] = None,
+                       host: Optional[str] = None,
+                       max_bytes: int = 1 << 18) -> Dict[str, dict]:
+    """Snapshot exchange over ``jax.distributed`` control collectives —
+    the beacon transport for fleets that share a mesh but no
+    filesystem.  Every process contributes its registry snapshot
+    (JSON, zero-padded to ``max_bytes``) to one allgather; returns
+    ``{host: snapshot}`` for ALL processes, ready to feed
+    ``FleetRegistry.ingest``.  Single-process (no mesh) degenerates to
+    just the local snapshot — callers need no special case."""
+    import numpy as np
+    if registry is None:
+        from deeplearning4j_tpu import telemetry
+        registry = telemetry.get_registry()
+    if host is None:
+        host = _default_host_id()
+    doc = {"host": str(host), "snapshot": registry.snapshot()}
+    import jax
+    if jax.process_count() == 1:
+        return {doc["host"]: doc["snapshot"]}
+    payload = json.dumps(doc).encode()
+    if len(payload) > max_bytes:
+        raise ValueError(
+            f"snapshot is {len(payload)} bytes > max_bytes="
+            f"{max_bytes}; raise max_bytes (all processes must agree "
+            "on it) or prune the registry")
+    buf = np.zeros((max_bytes,), np.uint8)
+    buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+    from jax.experimental import multihost_utils
+    gathered = np.asarray(
+        multihost_utils.process_allgather(buf))
+    out: Dict[str, dict] = {}
+    for row in gathered.reshape(-1, max_bytes):
+        raw = bytes(row.tobytes().rstrip(b"\x00"))
+        if not raw:
+            continue
+        peer = json.loads(raw.decode())
+        out[str(peer["host"])] = peer["snapshot"]
+    return out

@@ -54,6 +54,26 @@ def _fmt_labels(labelnames: Sequence[str], labelvalues: Sequence[str],
     return "{" + inner + "}"
 
 
+def parse_series(series: str) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+    """Invert the ``name{k="v",...}`` series strings ``snapshot()``
+    emits back into ``(name, ((k, v), ...))``.  Values may contain
+    commas/'='/escaped quotes (e.g. a mesh-shape label), so this
+    parses the quoted escape grammar ``_fmt_labels`` writes instead of
+    splitting on ','.  Shared by ``merge_snapshot`` and the fleet
+    aggregator (``telemetry.fleet``)."""
+    import re
+    if "{" not in series:
+        return series, ()
+    name, _, rest = series.partition("{")
+    unesc = lambda v: re.sub(
+        r"\\(.)", lambda m: {"n": "\n"}.get(m.group(1), m.group(1)), v)
+    pairs = [
+        (k, unesc(v)) for k, v in re.findall(
+            r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"',
+            rest.rstrip("}"))]
+    return name, tuple(pairs)
+
+
 class _Child:
     """One labeled time series; all mutation under ``self._lock``."""
 
@@ -386,24 +406,7 @@ class MetricsRegistry:
         ``jax.distributed`` workers each run their own registry; ship
         snapshots over your control plane and merge here).  Counters
         and histograms accumulate; gauges take the incoming value."""
-        import re
-
-        def split_series(series: str) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
-            if "{" not in series:
-                return series, ()
-            name, _, rest = series.partition("{")
-            # values may contain commas/'=' (e.g. a mesh-shape label);
-            # parse the quoted escape grammar _fmt_labels emits instead
-            # of splitting on ','
-            unesc = lambda v: re.sub(
-                r"\\(.)", lambda m: {"n": "\n"}.get(m.group(1),
-                                                    m.group(1)), v)
-            pairs = [
-                (k, unesc(v)) for k, v in re.findall(
-                    r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"',
-                    rest.rstrip("}"))]
-            return name, tuple(pairs)
-
+        split_series = parse_series
         for series, v in snap.get("counters", {}).items():
             name, pairs = split_series(series)
             fam = self.counter(name, labelnames=tuple(k for k, _ in pairs))

@@ -10,19 +10,81 @@ guarantees.  For per-op DEVICE timelines use ``ui.ProfilerListener``
 where Python time goes between program launches (data wait, dispatch,
 queue drain, serve batching).
 
+Beyond the ``with``-scoped form there are TRACKED spans
+(:meth:`SpanTracer.begin` -> :class:`Span`), the request-tracing
+primitive: a span opened on one thread may be ENDED on any other —
+a serving request's decode phase opens on the scheduler thread and
+closes on whichever thread retires the request (a watchdog-recovery
+thread included).  The pre-tracked design orphaned exactly that case:
+a span whose closing edge ran on a different thread was simply never
+flushed, so every watchdog-recovered request lost its trace.  Tracked
+spans also carry an optional OWNER binding (``bound=True``): a bound
+span dies with its opening thread, and ``end_owned_by(tid)`` flushes
+all of a superseded thread's bound spans (close-on-owner-death) — how
+a hung decode dispatch's tick span still reaches the trace file, with
+an ``error`` arg naming the recovery instead of vanishing.
+
+Request-scoped tracing rides on one convention: spans that belong to a
+request carry ``trace=<id>`` in their args (the id is minted at
+``ServingFleet.submit`` and flows through every component that touches
+the request).  ``events_for_trace(id)`` / ``export_chrome_trace(path,
+trace_id=id)`` then emit ONE cross-component tree per request.
+
 Thread-safe: the event buffer is a bounded ``deque`` (appends are
-atomic), each span carries the recording thread's id, and a long-lived
-serving process can't grow the buffer without end.
+atomic), the tracked-span table mutates only under ``self._lock``,
+each span records its opening thread's id, and a long-lived serving
+process can't grow either without end.
 """
 from __future__ import annotations
 
 import collections
 import contextlib
+import itertools
 import json
 import os
 import threading
 import time
 from typing import Dict, Iterator, List, Optional
+
+
+class Span:
+    """One tracked in-flight span (see :meth:`SpanTracer.begin`).
+
+    ``end()`` is idempotent and callable from ANY thread — the closing
+    edge of a request phase legitimately runs on a different thread
+    than the opening edge (scheduler vs. watchdog-recovery).  All
+    bookkeeping lives in the tracer; the span itself is an immutable
+    handle."""
+
+    __slots__ = ("name", "args", "ts", "tid", "bound", "owner",
+                 "_tracer", "_sid")
+
+    def __init__(self, tracer, sid, name, ts, tid, bound, owner, args):
+        self._tracer = tracer
+        self._sid = sid
+        self.name = name
+        self.ts = ts
+        self.tid = tid
+        self.bound = bound
+        self.owner = owner
+        self.args = args
+
+    def end(self, **extra) -> None:
+        """Record the complete event (first call wins; later calls and
+        calls on a no-op span are ignored)."""
+        if self._tracer is not None:
+            self._tracer._end(self._sid, extra)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, etype, exc, tb) -> bool:
+        self.end(**({"error": etype.__name__} if etype else {}))
+        return False
+
+
+#: the disabled-tracer span: every method is a no-op
+_NULL_SPAN = Span(None, -1, "", 0.0, 0, False, None, {})
 
 
 class SpanTracer:
@@ -32,6 +94,8 @@ class SpanTracer:
     >>> with tracer.span("serve/batch", size=4):
     ...     with tracer.span("serve/forward"):
     ...         pass
+    >>> sp = tracer.begin("request/decode", trace="r-1")   # tracked
+    >>> sp.end(tokens=64)                                  # any thread
     >>> tracer.export_jsonl("trace.jsonl")
     """
 
@@ -40,55 +104,129 @@ class SpanTracer:
         self._events: collections.deque = collections.deque(
             maxlen=max_events)
         self._t0 = time.perf_counter_ns()
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        self._open: Dict[int, Span] = {}
 
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._t0) / 1e3
 
+    # -- tracked spans --------------------------------------------------
+    def begin(self, name: str, bound: bool = False, owner=None,
+              **args) -> Span:
+        """Open a tracked span.  ``bound=True`` ties its lifetime to
+        an OWNER: :meth:`end_owned_by` flushes it when that owner is
+        superseded (hung dispatch, watchdog takeover).  ``owner``
+        defaults to the opening thread's ident, but long-lived
+        schedulers should pass a per-INCARNATION token (e.g. ``(id(
+        self), epoch)``) — CPython recycles thread idents of dead
+        threads, so a raw tid can collide with an unrelated thread
+        started after the owner died.  Unbound spans outlive threads
+        — a request phase ends wherever the request retires."""
+        if not self.enabled:
+            return _NULL_SPAN
+        if bound and owner is None:
+            owner = threading.get_ident()
+        sp = Span(self, next(self._seq), name, self._now_us(),
+                  threading.get_ident(), bound, owner, dict(args))
+        with self._lock:
+            self._open[sp._sid] = sp
+        return sp
+
+    def _end(self, sid: int, extra: Dict) -> None:
+        with self._lock:
+            sp = self._open.pop(sid, None)
+        if sp is None:
+            return                       # already ended (idempotent)
+        args = dict(sp.args, **extra) if extra else sp.args
+        self._events.append({
+            "name": sp.name, "ph": "X", "ts": sp.ts,
+            "dur": self._now_us() - sp.ts,
+            "pid": os.getpid(), "tid": sp.tid, "args": args,
+        })
+
+    def end_owned_by(self, owner, **extra) -> int:
+        """Close-on-owner-death: end every OPEN BOUND span whose
+        ``owner`` matches (watchdog recovery calls this with the
+        superseded scheduler's incarnation token so its in-flight
+        tick span flushes instead of orphaning).  Unbound (request)
+        spans are left open — the recovered request's retire path
+        still closes them into a complete trace.  Returns the number
+        flushed."""
+        if owner is None:
+            return 0
+        with self._lock:
+            victims = [s._sid for s in self._open.values()
+                       if s.bound and s.owner == owner]
+        for sid in victims:
+            self._end(sid, extra)
+        return len(victims)
+
+    def open_spans(self) -> List[Span]:
+        """The currently-open tracked spans (tests / leak checks)."""
+        with self._lock:
+            return list(self._open.values())
+
+    # -- scoped spans ---------------------------------------------------
     @contextlib.contextmanager
-    def span(self, name: str, **args) -> Iterator[None]:
+    def span(self, name: str, owner=None, **args) -> Iterator[None]:
         """Time a block; records one complete ("X") event on exit.
         Exceptions propagate; the span still records with an
-        ``"error"`` arg so a trace shows where a request died."""
+        ``"error"`` arg so a trace shows where a request died.
+        Implemented over a BOUND tracked span (``owner`` as in
+        :meth:`begin`), so a thread that hangs inside the block can
+        still have the span flushed by :meth:`end_owned_by`."""
         if not self.enabled:
             yield
             return
-        start = self._now_us()
+        sp = self.begin(name, bound=True, owner=owner, **args)
         try:
             yield
         except BaseException as e:
-            args = dict(args, error=type(e).__name__)
+            sp.end(error=type(e).__name__)
             raise
         finally:
-            self._events.append({
-                "name": name, "ph": "X", "ts": start,
-                "dur": self._now_us() - start,
-                "pid": os.getpid(), "tid": threading.get_ident(),
-                "args": args,
-            })
+            sp.end()
 
     def events(self) -> List[Dict]:
         return list(self._events)
 
+    def events_for_trace(self, trace_id: str) -> List[Dict]:
+        """Every recorded event carrying ``trace=<trace_id>`` in its
+        args — ONE request's cross-component tree, whatever threads
+        and components its phases ran on."""
+        return [ev for ev in self._events
+                if ev["args"].get("trace") == trace_id]
+
     def clear(self) -> None:
         self._events.clear()
+        with self._lock:
+            self._open.clear()
 
-    def export_jsonl(self, path: str) -> str:
-        """One Chrome trace event per line.  Perfetto/catapult accept
-        newline-delimited event objects; ``export_chrome_trace`` writes
-        the strict ``{"traceEvents": [...]}`` envelope instead."""
+    def export_jsonl(self, path: str,
+                     trace_id: Optional[str] = None) -> str:
+        """One Chrome trace event per line (``trace_id`` filters to one
+        request's tree).  Perfetto/catapult accept newline-delimited
+        event objects; ``export_chrome_trace`` writes the strict
+        ``{"traceEvents": [...]}`` envelope instead."""
         d = os.path.dirname(str(path))
         if d:
             os.makedirs(d, exist_ok=True)
+        evs = (self.events() if trace_id is None
+               else self.events_for_trace(trace_id))
         with open(path, "w") as f:
-            for ev in self.events():
+            for ev in evs:
                 f.write(json.dumps(ev) + "\n")
         return str(path)
 
-    def export_chrome_trace(self, path: str) -> str:
+    def export_chrome_trace(self, path: str,
+                            trace_id: Optional[str] = None) -> str:
         d = os.path.dirname(str(path))
         if d:
             os.makedirs(d, exist_ok=True)
+        evs = (self.events() if trace_id is None
+               else self.events_for_trace(trace_id))
         with open(path, "w") as f:
-            json.dump({"traceEvents": self.events(),
+            json.dump({"traceEvents": evs,
                        "displayTimeUnit": "ms"}, f)
         return str(path)

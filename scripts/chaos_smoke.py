@@ -389,6 +389,52 @@ def main() -> int:
     if outcome_total("migrated") - mig0 < 1:
         problems.append("fleet kill produced no migrated requests")
 
+    # -- closed-loop autoscaler (ISSUE 12): a step load on a 1-replica
+    # fleet must scale 1 -> 2 through the Autoscaler (replica-queue
+    # p99 pressure), then back 2 -> 1 once the load drains — with
+    # ZERO interactive deadline misses.  Asserted from the real
+    # scrape at the bottom, not in-process state. -------------------
+    from deeplearning4j_tpu.serving import AutoscalePolicy, Autoscaler
+    as_actions = registry.counter("fleet_autoscale_actions_total",
+                                  labelnames=("direction",))
+    up0 = as_actions.labels(direction="up").value
+    down0 = as_actions.labels(direction="down").value
+    fleet2 = ServingFleet(gpt, n_replicas=1, n_slots=2, max_len=32,
+                          block_size=4, tick_batch=1,
+                          tick_timeout_s=None)
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                          queue_wait_p99_target_s=0.02,
+                          up_consecutive=2, down_consecutive=4,
+                          cooldown_s=0.3)
+    scaler = Autoscaler(fleet2, pol, interval_s=0.05,
+                        tenant_classes={"analytics": "batch"}).start()
+    try:
+        pa = np.asarray([1, 2, 3, 4], np.int32)
+        fleet2.submit(pa, n_new=2, tenant="inter", timeout=300)
+        hs2 = [fleet2.submit_async(pa, n_new=24, tenant="inter",
+                                   deadline_s=300.0)
+               for _ in range(40)]
+        for i, h in enumerate(hs2):
+            try:
+                h.result(timeout=300)
+            except Exception as e:
+                problems.append(f"step-load request {i} failed: {e}")
+        drain_by = time.monotonic() + 120
+        while time.monotonic() < drain_by and scaler.target > 1:
+            time.sleep(0.05)
+    finally:
+        scaler.close()
+    if as_actions.labels(direction="up").value - up0 < 1:
+        problems.append("step load did not autoscale 1 -> 2")
+    if as_actions.labels(direction="down").value - down0 < 1:
+        problems.append("drained fleet did not autoscale 2 -> 1")
+    if scaler.target != 1:
+        problems.append(f"autoscaler target settled at {scaler.target}"
+                        " != 1")
+    if fleet2.stats()["healthy_replicas"] != 1:
+        problems.append("fleet healthy_replicas != 1 after scale-in")
+    fleet2.shutdown(drain=True)
+
     # -- sanitizer: one deliberate nan trip so the series has a
     # labeled child on the wire (check_finite itself is unconditional
     # — DL4J_TPU_SANITIZE gates the CALL SITES, not the check) -------
@@ -428,7 +474,11 @@ def main() -> int:
                    'fleet_resumes_total{outcome="resumed"}',
                    'fleet_elastic_resumes_total{direction="shrink"}',
                    "kv_slots_salvaged_total",
-                   "serve_watchdog_restarts_total"):
+                   "serve_watchdog_restarts_total",
+                   # the step-load scenario's autoscale actions, both
+                   # directions, on the wire (ISSUE 12)
+                   'fleet_autoscale_actions_total{direction="up"}',
+                   'fleet_autoscale_actions_total{direction="down"}'):
         for line in body.splitlines():
             if line.startswith(needle + " "):
                 if float(line.rsplit(" ", 1)[1]) <= 0:
@@ -447,6 +497,17 @@ def main() -> int:
         problems.append('fleet_requests_total{outcome="migrated"} '
                         "missing or 0 on the scrape after a replica "
                         "kill")
+    # ZERO interactive deadline misses through the 1->2->1 step load:
+    # the expired outcome for the interactive tenant must be absent
+    # (never minted) or scrape as 0
+    for line in body.splitlines():
+        if (line.startswith("fleet_requests_total{")
+                and 'tenant="inter"' in line
+                and 'outcome="expired"' in line
+                and float(line.rsplit(" ", 1)[1]) > 0):
+            problems.append(
+                "interactive tenant missed deadlines during the "
+                f"autoscale step load: {line}")
     required += ct.ANALYSIS_SERIES
     required += ['sanitizer_trips_total{mode="nan"}']
     problems += ct.missing_series(body, required)

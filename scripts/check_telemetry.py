@@ -106,7 +106,38 @@ FLEET_SERIES = [
     "fleet_queue_wait_seconds_bucket",
     "fleet_replicas_healthy",
     "fleet_queue_depth",
+    # request-phase decomposition (ISSUE 12): the fleet smoke's
+    # requests record per-phase spans, so every phase series carries
+    # live values; the deadline'd request feeds the EDF-slack family
+    'fleet_request_phase_seconds_bucket{phase="admission"',
+    'fleet_request_phase_seconds_bucket{phase="placement"',
+    'fleet_request_phase_seconds_bucket{phase="queue"',
+    'fleet_request_phase_seconds_bucket{phase="prefill"',
+    'fleet_request_phase_seconds_bucket{phase="decode"',
+    'fleet_request_phase_seconds_bucket{phase="total"',
+    'fleet_edf_slack_seconds_bucket{tenant="hot"',
 ]
+
+# Fleet observability plane (ISSUE 12): asserted over the AGGREGATED
+# 2-worker scrape (this process + a synthetic peer, both published as
+# beacons and merged by FleetRegistry) — every entry must appear
+# host-tagged AND rolled up.
+FLEET_OBS_SERIES = [
+    'generation_server_retired_total{host="workerA"}',
+    'generation_server_retired_total{host="workerB"}',
+    'generation_server_retired_total{host="fleet"}',
+    'fleet_request_phase_seconds_bucket{phase="decode",host="fleet"',
+    'fleet_requests_total{tenant="hot",outcome="admitted",host="fleet"}',
+    'fleet_host_up{host="workerA"} 1.0',
+    'fleet_host_up{host="workerB"} 1.0',
+    "fleet_hosts_live 2.0",
+    'fleet_beacon_publishes_total{host="workerA"}',
+]
+
+#: one complete cross-component request trace must carry all of these
+TRACE_PHASES = {"request", "request/admission", "request/placement",
+                "request/replica_queue", "request/prefill",
+                "request/decode"}
 
 # Static-analysis subsystem series: the lint counter gets labeled
 # children from emit_analysis_series() below, which also runs a real
@@ -336,7 +367,9 @@ def main() -> int:
                       tick_timeout_s=None) as fleet:
         fp = np.asarray([2, 7, 1, 8, 2, 8, 1, 8, 2], np.int32)
         out_hot = fleet.submit(fp, n_new=4, tenant="hot", timeout=300)
-        fh = fleet.submit_async(fp, n_new=4, tenant="hot")
+        # deadline'd so the EDF-slack histogram records at dispatch
+        fh = fleet.submit_async(fp, n_new=4, tenant="hot",
+                                deadline_s=300.0)
         out_rep = fh.result(timeout=300)
         out_cold = fleet.submit(np.asarray([6, 5, 4, 3], np.int32),
                                 n_new=4, tenant="cold", timeout=300)
@@ -352,6 +385,47 @@ def main() -> int:
                             "hit on the warm replica")
         if fleet.stats()["healthy_replicas"] != 2:
             problems.append("fleet not fully healthy after the smoke")
+        fleet_trace_id = fh.trace_id
+
+    # -- request-scoped tracing: the deadline'd request must have ONE
+    # complete cross-component trace (submit -> retire, every phase
+    # span stamped with its fleet-minted trace id) ------------------
+    tr_names = {e["name"]
+                for e in tracer.events_for_trace(fleet_trace_id)}
+    if not TRACE_PHASES <= tr_names:
+        problems.append(
+            f"request trace {fleet_trace_id} incomplete: missing "
+            f"{sorted(TRACE_PHASES - tr_names)}")
+    if tracer.open_spans():
+        problems.append(
+            "tracked spans left open after every request retired: "
+            f"{[s.name for s in tracer.open_spans()]}")
+
+    # -- fleet observability plane: TWO workers' beacons aggregate
+    # into ONE scrape with {host=} tags and fleet rollups -----------
+    worker_b = telemetry.MetricsRegistry()
+    worker_b.counter("generation_server_retired_total").inc(2)
+    worker_b.counter("fleet_requests_total",
+                     labelnames=("tenant", "outcome")).labels(
+                         tenant="hot", outcome="admitted").inc(3)
+    with tempfile.TemporaryDirectory() as d:
+        with telemetry.MetricsBeacon(d, host="workerA",
+                                     interval_s=60.0):
+            pass                 # start + final publish
+        telemetry.publish_beacon(d, "workerB", registry=worker_b)
+        fleet_view = telemetry.FleetRegistry(d, stale_after_s=3600.0)
+        obs_body = scrape_body(telemetry, fleet_view)
+    problems += missing_series(obs_body, FLEET_OBS_SERIES)
+    retired_roll = retired.value + 2
+    for line in obs_body.splitlines():
+        if line.startswith('generation_server_retired_total'
+                           '{host="fleet"} '):
+            if float(line.rsplit(" ", 1)[1]) != retired_roll:
+                problems.append(
+                    "fleet rollup retired_total "
+                    f"{line.rsplit(' ', 1)[1]} != sum of workers "
+                    f"{retired_roll}")
+            break
 
     # -- elastic fleet resume: a checkpoint recorded at world=2 is
     # fleet-resumed at world=1, so the shrink counter, world gauge and

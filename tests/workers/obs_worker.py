@@ -1,0 +1,56 @@
+"""Fleet-observability worker: one serving host of a 2-OS-process
+fleet.  Serves 3 requests through a 1-replica ServingFleet while a
+MetricsBeacon pushes its registry into the shared out_dir; rank 0
+additionally exports ONE request's cross-component trace (submit ->
+retire, every span stamped with the fleet-minted trace id).  The
+parent test aggregates the beacon FILES into one scrape and asserts
+both hosts + rollups + the complete trace from the artifacts alone.
+
+Usage: obs_worker.py <rank> <out_dir>
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+rank, out_dir = int(sys.argv[1]), sys.argv[2]
+host = f"host{rank:03d}"
+
+from deeplearning4j_tpu import telemetry  # noqa: E402
+from deeplearning4j_tpu.serving import ServingFleet  # noqa: E402
+from deeplearning4j_tpu.zoo.gpt import Gpt  # noqa: E402
+
+reg = telemetry.get_registry()
+beacon = telemetry.MetricsBeacon(out_dir, host=host,
+                                 interval_s=0.2).start()
+
+gpt = Gpt(vocab_size=50, max_len=32, d_model=32, n_layers=2,
+          n_heads=4, d_ff=64, seq_len=8, compute_dtype=None,
+          seed=3).init_graph()
+with ServingFleet(gpt, n_replicas=1, n_slots=2, max_len=32,
+                  block_size=4, tick_timeout_s=None) as fleet:
+    p = np.asarray([1, 2, 3, 4], np.int32)
+    hs = [fleet.submit_async(p, n_new=6, tenant="hot",
+                             deadline_s=300.0) for _ in range(3)]
+    outs = [h.result(timeout=300) for h in hs]
+    trace_id = hs[0].trace_id
+assert all(o.shape == (10,) for o in outs), [o.shape for o in outs]
+leaked = telemetry.get_tracer().open_spans()
+assert not leaked, [(s.name, s.args) for s in leaked]
+
+if rank == 0:
+    telemetry.get_tracer().export_jsonl(
+        os.path.join(out_dir, "trace_rank0.jsonl"), trace_id=trace_id)
+
+retired = reg.counter("generation_server_retired_total").value
+with open(os.path.join(out_dir, f"obs_rank{rank}.json"), "w") as f:
+    json.dump({"rank": rank, "host": host, "retired": retired,
+               "trace_id": trace_id}, f)
+beacon.close()                       # final totals land in the beacon
+print("OBS_WORKER_OK", rank)
