@@ -301,6 +301,13 @@ _PHASE = telemetry.histogram(
     "per-request phase wall times (the trace spans' durations)",
     labelnames=("phase",))
 
+#: prefill device-time sampling rate (ISSUE 13): the admit dispatch
+#: is async and ready() adds a block_until_ready IN the scheduler
+#: loop, so only 1-in-N admissions pays that bubble (the decode tick
+#: samples every dispatch — that site host-syncs anyway, so its
+#: sample is free)
+_PROFILE_PREFILL_EVERY = 4
+
 
 def _pow2_floor(n: int) -> int:
     """Largest power of two <= n (n >= 1) — scan lengths quantize to
@@ -1499,54 +1506,64 @@ class GenerationServer:
         with self._lock:
             kc, vc, state = self._kc, self._vc, self._state
         _sanitize.check_not_donated("serve/admit", kc, vc, state)
-        if matched:
-            # prefix HIT: gather the cached blocks, prefill only the
-            # suffix — scatter targets start at the first fresh block
-            suffix = req.prompt[p0:]
-            sb = -(-_bucket(len(suffix), self.max_len) // bs) * bs
-            padded = np.zeros((1, sb), np.int32)
-            padded[0, :len(suffix)] = suffix
-            n_sc = sb // bs
-            fresh = plan.phys[matched:matched + n_sc]
-            scatter_phys = np.zeros((n_sc,), np.int32)
-            scatter_phys[:len(fresh)] = fresh
-            dtb = (-(-_bucket(req.t0, self.max_len) // bs) * bs
-                   if self._spec is not None else 0)
-            extra = draft_ops(dtb) if self._spec is not None else ()
-            out = self._admit_hit_fn(sb, matched, dtb)(
-                emb_p, blk_stack, head_p, kc, vc, state,
-                jnp.asarray(padded), np.int32(p0),
-                np.int32(req.t0 - p0 - 1), np.int32(req.t0),
-                np.int32(slot), np.int32(req.n_new),
-                np.int32(req.eos_id), jax.random.PRNGKey(req.seed),
-                np.float32(req.temperature), np.int32(req.top_k),
-                np.float32(req.top_p),
-                jnp.asarray(plan.phys[:matched], jnp.int32),
-                jnp.asarray(scatter_phys), jnp.asarray(table_row),
-                jnp.asarray(dtable_row), *extra)
-        else:
-            tb = -(-_bucket(req.t0, self.max_len) // bs) * bs
-            padded = np.zeros((1, tb), np.int32)
-            padded[0, :req.t0] = req.prompt
-            n_sc = tb // bs
-            scatter_phys = np.zeros((n_sc,), np.int32)
-            head = plan.phys[:n_sc]
-            scatter_phys[:len(head)] = head
-            if self._spec is not None:
-                demb_p, dblk, dhead_p, dpad, dscatter = draft_ops(tb)
-                # miss path: draft shares the target's padded prompt
-                extra = (demb_p, dblk, dhead_p, dscatter)
+        # device-phase sample (ISSUE 13): the prefill dispatch is
+        # async — ready(out) pays the block_until_ready only on the
+        # 1-in-N sampled calls (explicit every=, NOT the profiler's
+        # default of 1), so unsampled admissions stay fully async
+        with telemetry.get_profiler().measure(
+                "prefill", every=_PROFILE_PREFILL_EVERY) as prof_m:
+            if matched:
+                # prefix HIT: gather the cached blocks, prefill only
+                # the suffix — scatter targets start at the first
+                # fresh block
+                suffix = req.prompt[p0:]
+                sb = -(-_bucket(len(suffix), self.max_len) // bs) * bs
+                padded = np.zeros((1, sb), np.int32)
+                padded[0, :len(suffix)] = suffix
+                n_sc = sb // bs
+                fresh = plan.phys[matched:matched + n_sc]
+                scatter_phys = np.zeros((n_sc,), np.int32)
+                scatter_phys[:len(fresh)] = fresh
+                dtb = (-(-_bucket(req.t0, self.max_len) // bs) * bs
+                       if self._spec is not None else 0)
+                extra = draft_ops(dtb) if self._spec is not None else ()
+                out = self._admit_hit_fn(sb, matched, dtb)(
+                    emb_p, blk_stack, head_p, kc, vc, state,
+                    jnp.asarray(padded), np.int32(p0),
+                    np.int32(req.t0 - p0 - 1), np.int32(req.t0),
+                    np.int32(slot), np.int32(req.n_new),
+                    np.int32(req.eos_id), jax.random.PRNGKey(req.seed),
+                    np.float32(req.temperature), np.int32(req.top_k),
+                    np.float32(req.top_p),
+                    jnp.asarray(plan.phys[:matched], jnp.int32),
+                    jnp.asarray(scatter_phys), jnp.asarray(table_row),
+                    jnp.asarray(dtable_row), *extra)
             else:
-                extra = ()
-            out = self._admit_miss_fn(tb)(
-                emb_p, blk_stack, head_p, kc, vc, state,
-                jnp.asarray(padded), np.int32(req.t0), np.int32(slot),
-                np.int32(req.n_new), np.int32(req.eos_id),
-                jax.random.PRNGKey(req.seed),
-                np.float32(req.temperature), np.int32(req.top_k),
-                np.float32(req.top_p), jnp.asarray(scatter_phys),
-                jnp.asarray(table_row), jnp.asarray(dtable_row),
-                *extra)
+                tb = -(-_bucket(req.t0, self.max_len) // bs) * bs
+                padded = np.zeros((1, tb), np.int32)
+                padded[0, :req.t0] = req.prompt
+                n_sc = tb // bs
+                scatter_phys = np.zeros((n_sc,), np.int32)
+                head = plan.phys[:n_sc]
+                scatter_phys[:len(head)] = head
+                if self._spec is not None:
+                    demb_p, dblk, dhead_p, dpad, dscatter = \
+                        draft_ops(tb)
+                    # miss path: draft shares the target's padded
+                    # prompt
+                    extra = (demb_p, dblk, dhead_p, dscatter)
+                else:
+                    extra = ()
+                out = self._admit_miss_fn(tb)(
+                    emb_p, blk_stack, head_p, kc, vc, state,
+                    jnp.asarray(padded), np.int32(req.t0),
+                    np.int32(slot), np.int32(req.n_new),
+                    np.int32(req.eos_id), jax.random.PRNGKey(req.seed),
+                    np.float32(req.temperature), np.int32(req.top_k),
+                    np.float32(req.top_p), jnp.asarray(scatter_phys),
+                    jnp.asarray(table_row), jnp.asarray(dtable_row),
+                    *extra)
+            prof_m.ready(out)
         _sanitize.mark_donated("serve/admit", kc, vc, state)
         with self._lock:
             if self._epoch != my_epoch:
@@ -1864,6 +1881,7 @@ class GenerationServer:
 
     def _run(self, my_epoch: int):
         tracer = telemetry.get_tracer()
+        prof = telemetry.get_profiler()
         stop = False
         while True:
             with self._lock:
@@ -2057,26 +2075,32 @@ class GenerationServer:
                     _sanitize.check_not_donated("serve/tick", kc_in,
                                                 vc_in, state_in)
                     n_prop = n_acc = 0
-                    if use_spec:
-                        demb_p, dblk, dhead_p = self._draft_params
-                        (kc, vc, state, toks, emitted, n_alive,
-                         prop, acc) = self._spec_fn(R)(
-                            emb_p, blk_stack, head_p, demb_p, dblk,
-                            dhead_p, kc_in, vc_in, state_in)
-                    else:
-                        kc, vc, state, toks, emitted, n_alive = \
-                            self._decode_scan(k, sampled)(
-                                emb_p, blk_stack, head_p, kc_in, vc_in,
-                                state_in)
-                    _sanitize.mark_donated("serve/tick", kc_in, vc_in,
-                                           state_in)
-                    # THE host sync: one poll per dispatch — tokens
-                    # staged [B, K] device-side, per-slot live-tick
-                    # counts, budgets left (all off one dispatch)
-                    toks_h = np.asarray(toks)
-                    emit_h = np.asarray(emitted)
-                    rem_h = np.asarray(state["remaining"])
-                    alive_h = int(n_alive)
+                    # device-phase sample (ISSUE 13): dispatch ->
+                    # host-sync is the device time of this tick; the
+                    # site already syncs (the np.asarray poll), so the
+                    # continuous profile costs one perf_counter pair
+                    with prof.measure("verify" if use_spec
+                                      else "decode_tick"):
+                        if use_spec:
+                            demb_p, dblk, dhead_p = self._draft_params
+                            (kc, vc, state, toks, emitted, n_alive,
+                             prop, acc) = self._spec_fn(R)(
+                                emb_p, blk_stack, head_p, demb_p, dblk,
+                                dhead_p, kc_in, vc_in, state_in)
+                        else:
+                            kc, vc, state, toks, emitted, n_alive = \
+                                self._decode_scan(k, sampled)(
+                                    emb_p, blk_stack, head_p, kc_in,
+                                    vc_in, state_in)
+                        _sanitize.mark_donated("serve/tick", kc_in,
+                                               vc_in, state_in)
+                        # THE host sync: one poll per dispatch — tokens
+                        # staged [B, K] device-side, per-slot live-tick
+                        # counts, budgets left (all off one dispatch)
+                        toks_h = np.asarray(toks)
+                        emit_h = np.asarray(emitted)
+                        rem_h = np.asarray(state["remaining"])
+                        alive_h = int(n_alive)
                     if use_spec:
                         n_prop, n_acc = int(prop), int(acc)
                     _HOST_SYNCS.inc()

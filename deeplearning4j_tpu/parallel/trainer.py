@@ -43,6 +43,11 @@ _STEPS = telemetry.counter(
     "sharded_steps_total", "compiled mesh steps dispatched",
     labelnames=("worker",))
 
+#: optimizer-step device-time sampling rate (ISSUE 13): 1-in-N steps
+#: pays a block_until_ready so the dispatch-ahead pipeline keeps its
+#: async overlap on the other N-1
+_PROFILE_STEP_EVERY = 4
+
 
 def _tp_shardable_layers(model) -> dict:
     """Per-layer tensor-parallel sharding rules: name -> {param: kind}
@@ -514,22 +519,37 @@ class ShardedTrainer:
             batch = self._shard_batch(
                 {"features": batch["features"],
                  "labels": batch["labels"]})
-            with tracer.span("train/pipeline_step",
-                             mesh=str(dict(self.mesh.shape))), self.mesh:
-                (self._pipe_params, self._pipe_opt, loss) = \
-                    self._pipe_step(self._pipe_params, self._pipe_opt,
-                                    m.iteration_count, batch,
-                                    float(getattr(m, "_lr_backoff", 1.0)))
+            # device-phase sample (ISSUE 13): 1-in-N steps pays a
+            # block_until_ready on the loss so the fleet scrape gains
+            # per-device optimizer-step time; the other steps keep the
+            # async dispatch-ahead pipeline intact
+            prof = telemetry.get_profiler()
+            with prof.measure("optimizer_step",
+                              every=_PROFILE_STEP_EVERY) as pm:
+                with tracer.span("train/pipeline_step",
+                                 mesh=str(dict(self.mesh.shape))), \
+                        self.mesh:
+                    (self._pipe_params, self._pipe_opt, loss) = \
+                        self._pipe_step(
+                            self._pipe_params, self._pipe_opt,
+                            m.iteration_count, batch,
+                            float(getattr(m, "_lr_backoff", 1.0)))
+                pm.ready(loss)
             self._model_stale = True
             self._step_counter.inc()   # dispatched, not failed validation
             return loss
         batch = self._shard_batch(batch)
-        with tracer.span("train/sharded_step",
-                         mesh=str(dict(self.mesh.shape))), self.mesh:
-            (m.params_tree, m.opt_state, m.state_tree, loss) = \
-                self.solver.step(m.params_tree, m.opt_state, m.state_tree,
-                                 m.iteration_count, batch, m._rng.next_key(),
-                                 lr_scale=getattr(m, "_lr_backoff", 1.0))
+        prof = telemetry.get_profiler()
+        with prof.measure("optimizer_step",
+                          every=_PROFILE_STEP_EVERY) as pm:
+            with tracer.span("train/sharded_step",
+                             mesh=str(dict(self.mesh.shape))), self.mesh:
+                (m.params_tree, m.opt_state, m.state_tree, loss) = \
+                    self.solver.step(
+                        m.params_tree, m.opt_state, m.state_tree,
+                        m.iteration_count, batch, m._rng.next_key(),
+                        lr_scale=getattr(m, "_lr_backoff", 1.0))
+            pm.ready(loss)
         self._step_counter.inc()
         return loss
 

@@ -24,7 +24,10 @@ TPU-fleet retrospective says must be designed in:
   (``telemetry.FleetRegistry``) against :class:`AutoscalePolicy` SLO
   targets and drives ``add_replica``/``remove_replica`` with
   hysteresis + cooldown, deferring/shedding batch-class tenants
-  before interactive ones.
+  before interactive ones — and PREDICTIVELY (ISSUE 13): a
+  :class:`BacklogForecaster` linear fit over the backlog series
+  pre-warms a replica when the projected queue depth crosses the SLO
+  horizon, before any reactive signal trips.
 
 Telemetry rides the PR-1 registry: ``fleet_requests_total{tenant=,
 outcome=}``, ``fleet_replica_dispatch_total{replica=,reason=}``,
@@ -34,7 +37,10 @@ decomposition), ``fleet_edf_slack_seconds{tenant=}``, and the
 ``fleet_autoscale_*`` action/shed series.
 """
 from deeplearning4j_tpu.serving.autoscale import (AutoscalePolicy,
-                                                  Autoscaler)
+                                                  Autoscaler,
+                                                  BacklogForecaster,
+                                                  fit_trend,
+                                                  predict_breach_s)
 from deeplearning4j_tpu.serving.errors import (DeadlineInfeasibleError,
                                                FleetAdmissionError,
                                                NoHealthyReplicaError,
@@ -49,7 +55,8 @@ from deeplearning4j_tpu.serving.tenancy import (TenantAccountant,
 
 __all__ = [
     "ServingFleet", "TenantQuota", "TenantAccountant",
-    "Autoscaler", "AutoscalePolicy",
+    "Autoscaler", "AutoscalePolicy", "BacklogForecaster",
+    "fit_trend", "predict_breach_s",
     "FleetAdmissionError", "QuotaExceededError",
     "DeadlineInfeasibleError", "NoHealthyReplicaError",
     "choose_replica", "replica_view",

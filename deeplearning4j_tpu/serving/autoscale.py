@@ -25,14 +25,30 @@ a scheduler-style cadence and drives the fleet:
   ``max_replicas`` (nothing left to scale), batch-class tenants are
   DEFERRED first (their waiting requests demoted below interactive
   priority via ``ServingFleet.demote_waiting``) and SHED second
-  (cancelled outright) — interactive tenants are never touched.
+  (cancelled outright) — interactive tenants are never touched;
+* **predictive pre-warm** (ISSUE 13) — the loop above is purely
+  REACTIVE: it scales only after an SLO signal already breached, and
+  a replica takes seconds to construct/compile, so the breach is paid
+  in queue time either way.  :class:`BacklogForecaster` closes that
+  gap: a windowed LINEAR FIT over the backlog series the registry
+  already carries (``fleet_queue_depth``) extrapolates the queue
+  growth rate; when the projected backlog crosses
+  ``queue_depth_high`` within ``forecast_horizon_s``, the forecast
+  counts as scale-UP pressure through the SAME hysteresis/cooldown
+  (it cannot flap what the reactive loop cannot flap) — a replica is
+  pre-warmed BEFORE any reactive signal trips, and the prediction
+  itself is observable (``fleet_autoscale_forecast{signal=}``,
+  ``fleet_autoscale_prewarms_total``).
 
 Telemetry: ``fleet_autoscale_actions_total{direction=}``,
 ``fleet_autoscale_{deferred,shed}_total{tenant=}``,
-``fleet_autoscale_replicas_target``, ``fleet_autoscale_pressure``.
+``fleet_autoscale_replicas_target``, ``fleet_autoscale_pressure``,
+``fleet_autoscale_forecast{signal=}``,
+``fleet_autoscale_prewarms_total``.
 """
 from __future__ import annotations
 
+import collections
 import logging
 import math
 import threading
@@ -64,6 +80,17 @@ _PRESSURE = telemetry.gauge(
     "fleet_autoscale_pressure",
     "last evaluation: +1 scale-up pressure, -1 scale-down headroom, "
     "0 neutral")
+_FORECAST = telemetry.gauge(
+    "fleet_autoscale_forecast",
+    "the predictive scaler's state by signal: slope (backlog items/s "
+    "from the windowed linear fit), backlog (fitted current value), "
+    "breach_s (projected seconds until queue_depth_high, -1 when no "
+    "breach is projected), firing (1 while the projection is inside "
+    "forecast_horizon_s)", labelnames=("signal",))
+_PREWARM = telemetry.counter(
+    "fleet_autoscale_prewarms_total",
+    "scale-ups taken on the FORECAST alone — a replica pre-warmed "
+    "before any reactive SLO signal tripped")
 
 
 class AutoscalePolicy:
@@ -76,13 +103,24 @@ class AutoscalePolicy:
     and ``free_blocks_floor`` are the direct backpressure/memory
     triggers.  ``up_consecutive`` / ``down_consecutive`` /
     ``cooldown_s`` are the hysteresis, ``defer_priority`` the value
-    batch-class waiting requests demote to when shedding starts."""
+    batch-class waiting requests demote to when shedding starts.
+
+    ``forecast_horizon_s`` (ISSUE 13) turns the PREDICTIVE path on:
+    when the windowed linear fit over the backlog series projects
+    ``queue_depth_high`` will be crossed within the horizon, the
+    projection counts as scale-up pressure through the SAME
+    hysteresis/cooldown, so a replica pre-warms before the reactive
+    signals trip.  ``forecast_window_s`` bounds the fit window,
+    ``forecast_min_points`` the samples required before the fit is
+    trusted.  Forecasting requires ``queue_depth_high`` — the
+    ceiling being projected against."""
 
     __slots__ = ("min_replicas", "max_replicas",
                  "queue_wait_p99_target_s", "edf_slack_p10_floor_s",
                  "queue_depth_high", "free_blocks_floor",
                  "up_consecutive", "down_consecutive", "cooldown_s",
-                 "shed_batch", "defer_priority")
+                 "shed_batch", "defer_priority", "forecast_horizon_s",
+                 "forecast_window_s", "forecast_min_points")
 
     def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
                  queue_wait_p99_target_s: float = 0.5,
@@ -91,7 +129,10 @@ class AutoscalePolicy:
                  free_blocks_floor: int = 0,
                  up_consecutive: int = 2, down_consecutive: int = 6,
                  cooldown_s: float = 2.0, shed_batch: bool = True,
-                 defer_priority: int = 8):
+                 defer_priority: int = 8,
+                 forecast_horizon_s: Optional[float] = None,
+                 forecast_window_s: float = 10.0,
+                 forecast_min_points: int = 4):
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         if not 1 <= self.min_replicas <= self.max_replicas:
@@ -110,6 +151,15 @@ class AutoscalePolicy:
         self.cooldown_s = float(cooldown_s)
         self.shed_batch = bool(shed_batch)
         self.defer_priority = int(defer_priority)
+        self.forecast_horizon_s = (None if forecast_horizon_s is None
+                                   else float(forecast_horizon_s))
+        self.forecast_window_s = float(forecast_window_s)
+        self.forecast_min_points = max(2, int(forecast_min_points))
+        if self.forecast_horizon_s is not None \
+                and self.queue_depth_high is None:
+            raise ValueError(
+                "forecast_horizon_s needs queue_depth_high — the "
+                "backlog ceiling the forecast projects against")
 
 
 def _window_quantile(uppers: Tuple[float, ...], counts: List[float],
@@ -137,6 +187,94 @@ def _window_quantile(uppers: Tuple[float, ...], counts: List[float],
             return lo + (rank - prev) / counts[i] * (ub - lo)
         lo = ub
     return uppers[-1] if uppers else math.nan
+
+
+def fit_trend(points: Iterable[Tuple[float, float]]
+              ) -> Optional[Tuple[float, float]]:
+    """Least-squares linear fit over ``(t, value)`` samples; returns
+    ``(slope, value_at_latest_t)`` or None when the fit is degenerate
+    (fewer than 2 points, or all at one instant).  The fitted value —
+    not the raw last sample — anchors the projection, so one noisy
+    reading cannot swing the predicted breach time."""
+    pts = [(float(t), float(v)) for t, v in points]
+    n = len(pts)
+    if n < 2:
+        return None
+    mt = sum(t for t, _ in pts) / n
+    mv = sum(v for _, v in pts) / n
+    var = sum((t - mt) ** 2 for t, _ in pts)
+    if var <= 0:
+        return None
+    slope = sum((t - mt) * (v - mv) for t, v in pts) / var
+    t_last = max(t for t, _ in pts)
+    return slope, mv + slope * (t_last - mt)
+
+
+def predict_breach_s(points: Iterable[Tuple[float, float]],
+                     threshold: float,
+                     fit: Optional[Tuple[float, float]] = None
+                     ) -> Optional[float]:
+    """Seconds until the fitted backlog trend crosses ``threshold``
+    (0.0 when already over it), or None when no breach is projected
+    (flat/shrinking trend, or a degenerate fit).  ``fit`` short-
+    circuits the regression when the caller already ran it (the
+    control loop computes one fit per pass).  The forecast-math
+    unit: a synthetic ramp ``v = a*t`` must predict ``(threshold -
+    v_now) / a`` exactly."""
+    if fit is None:
+        fit = fit_trend(points)
+    if fit is None:
+        return None
+    slope, v_now = fit
+    if v_now >= float(threshold):
+        return 0.0
+    if slope <= 1e-9:
+        return None
+    return (float(threshold) - v_now) / slope
+
+
+class BacklogForecaster:
+    """Windowed queue-growth extrapolation (the predictive half of
+    ISSUE 13).  ``observe`` feeds one ``(now, backlog)`` sample per
+    control-loop pass (the backlog series the registry already
+    carries — ``fleet_queue_depth``); ``breach_s`` fits the window
+    and publishes the prediction to the ``fleet_autoscale_forecast``
+    gauge family so the forecast is as observable as the signals it
+    predicts.  The shared window mutates only under ``self._lock`` —
+    ``observe``/``breach_s`` may be driven from the autoscaler thread
+    while tests and dashboards read concurrently."""
+
+    def __init__(self, window_s: float = 10.0, min_points: int = 4):
+        self.window_s = float(window_s)
+        self.min_points = max(2, int(min_points))
+        self._lock = threading.Lock()
+        self._pts: "collections.deque" = collections.deque()
+
+    def observe(self, now: float, backlog: float) -> None:
+        now = float(now)
+        with self._lock:
+            self._pts.append((now, float(backlog)))
+            while self._pts and self._pts[0][0] < now - self.window_s:
+                self._pts.popleft()
+
+    def breach_s(self, threshold: float) -> Optional[float]:
+        """Projected seconds until ``threshold``; None when the window
+        is too thin or the trend projects no breach.  Publishes the
+        slope/backlog/breach_s gauges either way."""
+        with self._lock:
+            pts = list(self._pts)
+        if len(pts) < self.min_points:
+            return None
+        fit = fit_trend(pts)
+        if fit is None:
+            return None
+        slope, v_now = fit
+        breach = predict_breach_s(pts, threshold, fit=fit)
+        _FORECAST.labels(signal="slope").set(slope)
+        _FORECAST.labels(signal="backlog").set(v_now)
+        _FORECAST.labels(signal="breach_s").set(
+            -1.0 if breach is None else breach)
+        return breach
 
 
 class Autoscaler:
@@ -180,6 +318,10 @@ class Autoscaler:
         self._last_action = float("-inf")
         self._deferred = False         # defer fired since pressure rose
         self._hist_prev: Dict[str, Tuple[List[float], float]] = {}
+        self._forecaster = (
+            BacklogForecaster(self.policy.forecast_window_s,
+                              self.policy.forecast_min_points)
+            if self.policy.forecast_horizon_s is not None else None)
         _TARGET.set(self._target)
 
     # -- signal readers ------------------------------------------------
@@ -302,6 +444,28 @@ class Autoscaler:
             reg, "fleet_edf_slack_seconds", 0.10)
             if pol.edf_slack_p10_floor_s is not None else None)
         qdepth = self._gauge_sum(reg, "fleet_queue_depth") or 0.0
+        # ONE lock-consistent fleet snapshot per pass: the forecast
+        # backlog and the target re-base below both read it
+        try:
+            fstats = self.fleet.stats()
+        except Exception:
+            fstats = None
+        # the BACKLOG the forecaster extrapolates is two-stage, like
+        # the wait signal: the fleet wait line PLUS the replica-
+        # internal queues the greedy dispatch pushes into (a burst
+        # lands there within one pass, leaving fleet_queue_depth ~0).
+        # Summed from the fleet's own per-replica stats — the
+        # process-global generation_server_queue_depth gauge is
+        # last-write-wins across replicas and reads ONE replica's
+        # queue, not the sum.  Dead/removed replicas are excluded
+        # (like n_live below): an organically-dead server's stranded
+        # queue_depth never drains, and counting it would both
+        # double-count the migrated work and pin a phantom breach
+        # that blocks scale-down forever
+        backlog = qdepth + (sum(r.get("queue_depth", 0) or 0
+                                for r in fstats["replicas"]
+                                if not r["dead"] and not r["removed"])
+                            if fstats else 0.0)
         free_blocks = self._gauge_sum(reg, "kv_pool_blocks_free")
         healthy = self._gauge_sum(reg, "fleet_replicas_healthy") or 0.0
 
@@ -318,6 +482,22 @@ class Autoscaler:
         if pol.free_blocks_floor and free_blocks is not None \
                 and free_blocks < pol.free_blocks_floor:
             up_reasons.append(f"free_blocks={free_blocks:g}")
+        # predictive pre-warm (ISSUE 13): the forecast fires BEFORE
+        # any reactive signal, but through the same streak/cooldown
+        # gate — prediction adds lead time, never a new flap mode.
+        # forecast_only records whether an eventual up action was
+        # taken on the projection alone (the prewarm accounting).
+        forecast_only = False
+        if self._forecaster is not None:
+            self._forecaster.observe(now, backlog)
+            breach = self._forecaster.breach_s(pol.queue_depth_high)
+            firing = (breach is not None
+                      and breach <= pol.forecast_horizon_s
+                      and backlog > 0)
+            _FORECAST.labels(signal="firing").set(float(firing))
+            if firing:
+                forecast_only = not up_reasons
+                up_reasons.append(f"forecast_breach_s={breach:.3g}")
         # scale-down headroom: nothing waiting, no fresh SLO pressure,
         # and (checked under the lock below) every targeted replica
         # actually became healthy — never judge "idle" while a
@@ -331,11 +511,9 @@ class Autoscaler:
         # stale target — that would both block scale-down forever
         # (healthy can never reach it) and refuse scale-up at a
         # phantom max while fewer replicas actually live
-        try:
-            n_live = sum(1 for r in self.fleet.stats()["replicas"]
-                         if not r["dead"] and not r["removed"])
-        except Exception:
-            n_live = None
+        n_live = (sum(1 for r in fstats["replicas"]
+                      if not r["dead"] and not r["removed"])
+                  if fstats is not None else None)
 
         with self._lock:
             if n_live is not None:
@@ -391,8 +569,16 @@ class Autoscaler:
             with self._lock:
                 self._added.append(idx)
             _ACTIONS.labels(direction="up").inc()
-            log.info("autoscaler: scaled UP to %d (replica %d): %s",
-                     target, idx, ", ".join(up_reasons))
+            if forecast_only:
+                # the reactive signals were all quiet: this replica
+                # exists because the projection said the SLO horizon
+                # would be crossed — the pre-warm the predictive path
+                # is for
+                _PREWARM.inc()
+            log.info("autoscaler: scaled UP to %d (replica %d)%s: %s",
+                     target, idx,
+                     " [predictive pre-warm]" if forecast_only else "",
+                     ", ".join(up_reasons))
         elif action == "down":
             if remove_idx is not None and not self._removable(remove_idx):
                 # the loop's own add may have died or been removed

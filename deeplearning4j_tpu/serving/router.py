@@ -313,7 +313,8 @@ class ServingFleet:
                      eos_id: Optional[int] = None, seed: int = 0,
                      priority: int = 0,
                      deadline_s: Optional[float] = None,
-                     sampling: Optional[dict] = None) -> _FleetRequest:
+                     sampling: Optional[dict] = None,
+                     trace_id: Optional[str] = None) -> _FleetRequest:
         """Enqueue one request under ``tenant``'s quota; returns a
         handle whose ``result()`` blocks.  ``priority`` orders
         dispatch (lower = sooner); within a priority class requests
@@ -322,7 +323,14 @@ class ServingFleet:
         screened HERE — an unmeetable deadline raises
         :class:`DeadlineInfeasibleError` before any replica state is
         touched.  Structurally-unadmittable quota violations raise
-        :class:`QuotaExceededError` the same way."""
+        :class:`QuotaExceededError` the same way.
+
+        ``trace_id`` CONTINUES an existing trace instead of minting
+        one (ISSUE 13) — the cross-host handoff path: a request
+        migrating in from another host's fleet keeps its origin trace
+        id, its local root span is named ``request/handoff``, and the
+        aggregator's ``FleetTraceStore`` stitches this host's
+        fragment under the origin host's submit->retire root."""
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("ServingFleet has been shut down")
@@ -366,10 +374,16 @@ class ServingFleet:
         # whole fleet residence plus the admission phase, both tagged
         # with the minted trace id every later component (placement,
         # replica queue/prefill/decode) stamps its own spans with —
-        # one submit -> retire tree per request in the trace viewer
+        # one submit -> retire tree per request in the trace viewer.
+        # A handed-off request keeps its ORIGIN id and roots its local
+        # fragment at request/handoff, so the fleet trace store sees
+        # one tree, not two roots.
         tracer = telemetry.get_tracer()
+        if trace_id is not None:
+            req.trace_id = str(trace_id)
         req.spans["request"] = tracer.begin(
-            "request", trace=req.trace_id, tenant=tenant,
+            "request" if trace_id is None else "request/handoff",
+            trace=req.trace_id, tenant=tenant,
             n_new=n_new, priority=int(priority))
         req.spans["admission"] = tracer.begin(
             "request/admission", trace=req.trace_id, tenant=tenant)

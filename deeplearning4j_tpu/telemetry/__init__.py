@@ -16,7 +16,14 @@ pieces, all stdlib-only:
   beacons pushed into a shared dir (or over ``jax.distributed``
   collectives) and ``FleetRegistry`` aggregation into ONE
   ``{host=}``-tagged scrape with rollups, reset detection and
-  staleness marking.
+  staleness marking;
+* ``profiling`` — continuous per-device profiling (ISSUE 13): a
+  sampling ``DeviceProfiler`` wraps the hot dispatch sites (decode
+  tick, verify, prefill, optimizer step) with device-time measurement
+  into ``fleet_device_phase_seconds{device=,phase=}`` plus an
+  on-demand XProf capture trigger whose summary beacons fleet-wide;
+  the beacons also ship closed request spans, which ``FleetRegistry``
+  stitches into per-request trees in a ``FleetTraceStore``.
 
 Instrumented in-tree: ``optimize.fit_loop`` (step/data-wait split,
 iteration/epoch/example counters), ``parallel.trainer`` and
@@ -39,15 +46,18 @@ from typing import Optional, Sequence
 from deeplearning4j_tpu.telemetry.registry import (
     DEFAULT_BUCKETS, RATIO_BUCKETS, Counter, Gauge, Histogram,
     MetricsRegistry, parse_series)
-from deeplearning4j_tpu.telemetry.tracing import Span, SpanTracer
+from deeplearning4j_tpu.telemetry.tracing import (FleetTraceStore, Span,
+                                                  SpanTracer)
 from deeplearning4j_tpu.telemetry.exposition import (
     MetricsServer, start_metrics_server)
 from deeplearning4j_tpu.telemetry.listener import TelemetryListener
 from deeplearning4j_tpu.telemetry.fleet import (
     FleetRegistry, MetricsBeacon, exchange_snapshots, publish_beacon)
+from deeplearning4j_tpu.telemetry.profiling import DeviceProfiler
 
 _REGISTRY = MetricsRegistry()
 _TRACER = SpanTracer()
+_PROFILER = DeviceProfiler(_REGISTRY)
 
 
 def get_registry() -> MetricsRegistry:
@@ -58,6 +68,12 @@ def get_registry() -> MetricsRegistry:
 def get_tracer() -> SpanTracer:
     """The process-wide default span tracer."""
     return _TRACER
+
+
+def get_profiler() -> DeviceProfiler:
+    """The process-wide sampling device profiler (ISSUE 13) the hot
+    dispatch sites report into."""
+    return _PROFILER
 
 
 def counter(name: str, documentation: str = "",
@@ -84,8 +100,9 @@ def span(name: str, **args):
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "SpanTracer",
     "Span", "MetricsServer", "start_metrics_server", "TelemetryListener",
-    "FleetRegistry", "MetricsBeacon", "publish_beacon",
-    "exchange_snapshots", "parse_series",
+    "FleetRegistry", "FleetTraceStore", "MetricsBeacon", "publish_beacon",
+    "exchange_snapshots", "parse_series", "DeviceProfiler",
     "DEFAULT_BUCKETS", "RATIO_BUCKETS",
-    "get_registry", "get_tracer", "counter", "gauge", "histogram", "span",
+    "get_registry", "get_tracer", "get_profiler",
+    "counter", "gauge", "histogram", "span",
 ]

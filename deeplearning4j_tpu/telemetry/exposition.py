@@ -5,10 +5,17 @@ server" stance stands for dashboards); a scrape endpoint is different —
 it is how a fleet's Prometheus/VictoriaMetrics reaches a training or
 serving process, and ``ThreadingHTTPServer`` from the stdlib is enough:
 a scrape is one GET returning one rendered string.
+
+When the served registry carries a cross-worker trace store (a
+``FleetRegistry`` — ISSUE 13), the same endpoint also answers
+``/traces`` (store summary + trace ids) and ``/traces?id=<trace>``
+(ONE stitched submit->retire tree as JSON) — the query surface the
+trace store exists for.
 """
 from __future__ import annotations
 
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -39,12 +46,27 @@ class MetricsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                if self.path.split("?")[0] not in ("/metrics", "/"):
+                path = self.path.split("?")[0]
+                traces = getattr(registry, "traces", None)
+                if path == "/traces" and traces is not None:
+                    # fold the latest beacons in first, like a scrape
+                    refresh = getattr(registry, "refresh", None)
+                    if callable(refresh) and getattr(
+                            registry, "directory", None) is not None:
+                        refresh()
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    tid = q.get("id", [None])[0]
+                    body = traces.render_json(tid).encode()
+                    ctype = "application/json"
+                elif path in ("/metrics", "/"):
+                    body = registry.render_prometheus().encode()
+                    ctype = CONTENT_TYPE
+                else:
                     self.send_error(404)
                     return
-                body = registry.render_prometheus().encode()
                 self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

@@ -42,6 +42,16 @@ that plane, in three transport-agnostic pieces:
 The closed-loop consumer is ``serving.autoscale.Autoscaler``, which
 evaluates this aggregated view against SLO targets and drives the
 PR 10 ``add_replica``/``remove_replica`` actuators.
+
+ISSUE 13 extends the plane beyond metrics: each beacon ships the
+tracer's closed request-scoped spans beside the snapshot
+(``SpanTracer.trace_events`` — seq-deduped, so duplicate delivery is
+free), and :class:`FleetRegistry` feeds them into a
+:class:`~deeplearning4j_tpu.telemetry.tracing.FleetTraceStore` so a
+request that crossed hosts (migration, recovery, handoff) is ONE
+stitched submit->retire tree queryable from the scrape endpoint
+(``/traces``), with ``fleet_trace_store_*`` gauges on the scrape
+making the store itself observable.
 """
 from __future__ import annotations
 
@@ -55,6 +65,8 @@ from typing import Dict, List, Optional, Tuple
 from deeplearning4j_tpu.telemetry.registry import (MetricsRegistry,
                                                    _escape_label,
                                                    parse_series)
+from deeplearning4j_tpu.telemetry.tracing import (FleetTraceStore,
+                                                  SpanTracer)
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -83,10 +95,14 @@ def beacon_path(directory, host: str) -> str:
 
 def publish_beacon(directory, host: Optional[str] = None,
                    registry: Optional[MetricsRegistry] = None,
-                   snapshot: Optional[dict] = None) -> str:
+                   snapshot: Optional[dict] = None,
+                   trace_events: Optional[list] = None) -> str:
     """Serialize one registry snapshot into this host's beacon file
     (atomic publish).  Returns the beacon path.  The one-shot form of
-    what :class:`MetricsBeacon` does on a cadence."""
+    what :class:`MetricsBeacon` does on a cadence.  ``trace_events``
+    (``SpanTracer.trace_events``) rides in the same document so closed
+    request spans reach the aggregator's trace store with the metrics
+    — one transport, one atomic publish."""
     from deeplearning4j_tpu.resilience.coordination import (
         atomic_publish_json)
     if host is None:
@@ -100,9 +116,11 @@ def publish_beacon(directory, host: Optional[str] = None,
             registry = telemetry.get_registry()
         snapshot = registry.snapshot()
     path = beacon_path(directory, host)
-    atomic_publish_json(path, {"host": host, "pid": os.getpid(),
-                               "t": time.time(),
-                               "snapshot": snapshot})
+    doc = {"host": host, "pid": os.getpid(), "t": time.time(),
+           "snapshot": snapshot}
+    if trace_events is not None:
+        doc["traces"] = list(trace_events)
+    atomic_publish_json(path, doc)
     return path
 
 
@@ -121,7 +139,9 @@ class MetricsBeacon:
 
     def __init__(self, directory, host: Optional[str] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 interval_s: float = 2.0):
+                 interval_s: float = 2.0,
+                 tracer: Optional[SpanTracer] = None,
+                 trace_limit: int = 4096):
         self.directory = str(directory)
         self.host = str(host) if host is not None else _default_host_id()
         if os.sep in self.host:
@@ -130,6 +150,14 @@ class MetricsBeacon:
             from deeplearning4j_tpu import telemetry
             registry = telemetry.get_registry()
         self.registry = registry
+        # trace transport (ISSUE 13): closed request-scoped spans ride
+        # every beacon.  Defaults to the process tracer; trace_limit=0
+        # turns the trace lane off (metrics-only beacon).
+        if tracer is None and trace_limit:
+            from deeplearning4j_tpu import telemetry
+            tracer = telemetry.get_tracer()
+        self.tracer = tracer
+        self.trace_limit = int(trace_limit)
         self.interval_s = float(interval_s)
         if self.interval_s <= 0:
             raise ValueError("interval_s must be > 0")
@@ -142,8 +170,20 @@ class MetricsBeacon:
         self._thread: Optional[threading.Thread] = None
 
     def publish(self) -> str:
-        """One immediate publish (also what the loop calls)."""
-        path = publish_beacon(self.directory, self.host, self.registry)
+        """One immediate publish (also what the loop calls).
+
+        The trace lane deliberately ships the FULL tagged tail every
+        time (bounded by ``trace_limit``), not a since-last-publish
+        delta: each publish REPLACES the beacon file, so an aggregator
+        that starts late or polls slower than the publish cadence
+        would permanently miss any span shipped only incrementally.
+        Receivers dedupe by (host, trace, pid, seq), so re-delivery costs
+        bytes, never correctness."""
+        traces = (self.tracer.trace_events(self.trace_limit)
+                  if self.tracer is not None and self.trace_limit
+                  else None)
+        path = publish_beacon(self.directory, self.host, self.registry,
+                              trace_events=traces)
         self._publishes.inc()
         return path
 
@@ -151,7 +191,10 @@ class MetricsBeacon:
         while not self._stop.wait(self.interval_s):
             try:
                 self.publish()
-            except OSError:      # shared dir flake: retry next tick
+            except Exception:    # shared-dir flake, serialization
+                # hiccup, tracer churn — the beacon is a host's ONLY
+                # window into the fleet view; one bad publish must
+                # never silence it permanently
                 log.exception("MetricsBeacon publish failed; retrying "
                               "at the next interval")
 
@@ -219,11 +262,16 @@ class FleetRegistry:
     per series: a total that decreased starts a fresh epoch and folds
     in wholesale instead of as a negative delta."""
 
-    def __init__(self, directory=None, stale_after_s: float = 10.0):
+    def __init__(self, directory=None, stale_after_s: float = 10.0,
+                 trace_store: Optional[FleetTraceStore] = None):
         self.directory = str(directory) if directory is not None else None
         self.stale_after_s = float(stale_after_s)
         self._lock = threading.Lock()
         self._hosts: Dict[str, _HostState] = {}
+        # the cross-worker trace store: beacons' trace tails fold in
+        # beside the metric snapshots (own lock, own dedup)
+        self.traces = (trace_store if trace_store is not None
+                       else FleetTraceStore())
 
     # -- fold ----------------------------------------------------------
     def ingest(self, host: str, snapshot: dict,
@@ -325,6 +373,9 @@ class FleetRegistry:
             except (OSError, ValueError, KeyError):
                 continue          # mid-replace or foreign file
             self.ingest(host, snap, now=now)
+            traces = doc.get("traces")
+            if traces:
+                self.traces.ingest(host, traces)
             seen.append(host)
         return seen
 
@@ -431,6 +482,22 @@ class FleetRegistry:
             "fleet_hosts_stale",
             "hosts whose beacon aged out (their gauges left the "
             "rollups; their counters remain)").set(n_stale)
+        ts = self.traces.summary()
+        view.gauge(
+            "fleet_trace_store_traces",
+            "distinct request trace ids the cross-worker trace store "
+            "currently holds").set(ts["traces"])
+        view.gauge(
+            "fleet_trace_store_spans",
+            "beaconed request spans held across all stored traces "
+            "(deduped by (host, trace, pid, seq))").set(ts["spans"])
+        view.gauge(
+            "fleet_trace_store_rooted",
+            "stored traces whose submit-minted root span has arrived "
+            "(the rest are orphan fragments awaiting their root; a "
+            "rooted trace can still report complete=false at /traces "
+            "if stray same-host fragments fall outside the root)").set(
+                ts["rooted"])
         return view
 
     @staticmethod

@@ -386,24 +386,67 @@ def main() -> int:
                                 f"failed: {e}")
         if fleet.stats()["healthy_replicas"] != 1:
             problems.append("fleet survivor count != 1 after kill")
+        mig_trace = hs[0].trace_id
     if outcome_total("migrated") - mig0 < 1:
         problems.append("fleet kill produced no migrated requests")
 
-    # -- closed-loop autoscaler (ISSUE 12): a step load on a 1-replica
-    # fleet must scale 1 -> 2 through the Autoscaler (replica-queue
-    # p99 pressure), then back 2 -> 1 once the load drains — with
-    # ZERO interactive deadline misses.  Asserted from the real
-    # scrape at the bottom, not in-process state. -------------------
+    # -- cross-worker trace store (ISSUE 13): the killed replica's
+    # request crossed placements mid-decode — its spans (abandoned
+    # victim placement INCLUDED, flushed by the owner-death path)
+    # must beacon and stitch into exactly ONE submit -> retire tree,
+    # not the disjoint fragments PR 12 left behind ------------------
+    with tempfile.TemporaryDirectory() as td:
+        telemetry.publish_beacon(
+            td, "chaoshost", registry=registry,
+            trace_events=telemetry.get_tracer().trace_events())
+        fr = telemetry.FleetRegistry(td, stale_after_s=3600.0)
+        fr.refresh()
+        tree = fr.traces.tree(mig_trace)
+    if tree["root"] is None:
+        problems.append("kill-mid-decode trace has no stitched root "
+                        f"(trace {mig_trace})")
+    else:
+        def _count(node, name):
+            return ((node["name"] == name)
+                    + sum(_count(c, name) for c in node["children"]))
+        if tree["orphans"]:
+            problems.append(
+                "kill-mid-decode trace left orphan fragments: "
+                f"{[n['name'] for n in tree['orphans']]}")
+        if _count(tree["root"], "request/placement") < 2:
+            problems.append(
+                "migrated request's tree holds < 2 placement spans "
+                "(victim + failover) — the recovery fragment was "
+                "lost")
+
+    # -- closed-loop autoscaler (ISSUE 12 + 13): the step load on a
+    # 1-replica fleet must now scale 1 -> 2 PREDICTIVELY — the
+    # backlog jump's growth rate projects a queue_depth_high breach
+    # inside the horizon and pre-warms the replica while every
+    # reactive signal is still quiet (the 1s wait target CANNOT have
+    # tripped before 1s of queueing even existed; the forecast fires
+    # within the first few 0.05s evaluations) — then back 2 -> 1 once
+    # the load drains, with ZERO interactive deadline misses.
+    # Asserted from the real scrape at the bottom: the pre-warm
+    # counter only increments when the up action's reasons were
+    # forecast-ONLY, so prewarms >= 1 IS "replica added before the
+    # reactive breach signal".
     from deeplearning4j_tpu.serving import AutoscalePolicy, Autoscaler
     as_actions = registry.counter("fleet_autoscale_actions_total",
                                   labelnames=("direction",))
+    prewarms = registry.counter("fleet_autoscale_prewarms_total")
     up0 = as_actions.labels(direction="up").value
     down0 = as_actions.labels(direction="down").value
+    pw0 = prewarms.value
     fleet2 = ServingFleet(gpt, n_replicas=1, n_slots=2, max_len=32,
                           block_size=4, tick_batch=1,
                           tick_timeout_s=None)
     pol = AutoscalePolicy(min_replicas=1, max_replicas=2,
-                          queue_wait_p99_target_s=0.02,
+                          queue_wait_p99_target_s=1.0,
+                          queue_depth_high=64,
+                          forecast_horizon_s=60.0,
+                          forecast_window_s=2.0,
+                          forecast_min_points=3,
                           up_consecutive=2, down_consecutive=4,
                           cooldown_s=0.3)
     scaler = Autoscaler(fleet2, pol, interval_s=0.05,
@@ -426,6 +469,10 @@ def main() -> int:
         scaler.close()
     if as_actions.labels(direction="up").value - up0 < 1:
         problems.append("step load did not autoscale 1 -> 2")
+    if prewarms.value - pw0 < 1:
+        problems.append(
+            "step load scaled up REACTIVELY — the forecast did not "
+            "pre-warm the replica before an SLO signal tripped")
     if as_actions.labels(direction="down").value - down0 < 1:
         problems.append("drained fleet did not autoscale 2 -> 1")
     if scaler.target != 1:
@@ -478,7 +525,10 @@ def main() -> int:
                    # the step-load scenario's autoscale actions, both
                    # directions, on the wire (ISSUE 12)
                    'fleet_autoscale_actions_total{direction="up"}',
-                   'fleet_autoscale_actions_total{direction="down"}'):
+                   'fleet_autoscale_actions_total{direction="down"}',
+                   # the predictive pre-warm that beat the reactive
+                   # signals to the scale-up (ISSUE 13)
+                   "fleet_autoscale_prewarms_total"):
         for line in body.splitlines():
             if line.startswith(needle + " "):
                 if float(line.rsplit(" ", 1)[1]) <= 0:
@@ -510,6 +560,15 @@ def main() -> int:
                 f"autoscale step load: {line}")
     required += ct.ANALYSIS_SERIES
     required += ['sanitizer_trips_total{mode="nan"}']
+    # ISSUE 13: the prediction gauges the step-load scenario drove,
+    # and the optimizer-step device-phase samples the pipeline chaos
+    # run's ShardedTrainer folded in
+    required += [
+        'fleet_autoscale_forecast{signal="firing"}',
+        'fleet_autoscale_forecast{signal="breach_s"}',
+        'fleet_device_phase_seconds_bucket{device="cpu:0",'
+        'phase="optimizer_step"',
+    ]
     problems += ct.missing_series(body, required)
 
     print(json.dumps({"ok": not problems, "problems": problems}))

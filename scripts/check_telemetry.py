@@ -121,7 +121,10 @@ FLEET_SERIES = [
 # Fleet observability plane (ISSUE 12): asserted over the AGGREGATED
 # 2-worker scrape (this process + a synthetic peer, both published as
 # beacons and merged by FleetRegistry) — every entry must appear
-# host-tagged AND rolled up.
+# host-tagged AND rolled up.  ISSUE 13 widens the allowlist: the
+# continuous device-phase profile must arrive host-tagged from BOTH
+# workers with a fleet rollup, and the trace-store gauges must be on
+# the same scrape.
 FLEET_OBS_SERIES = [
     'generation_server_retired_total{host="workerA"}',
     'generation_server_retired_total{host="workerB"}',
@@ -132,6 +135,37 @@ FLEET_OBS_SERIES = [
     'fleet_host_up{host="workerB"} 1.0',
     "fleet_hosts_live 2.0",
     'fleet_beacon_publishes_total{host="workerA"}',
+    # per-device continuous profiling (ISSUE 13): the real worker's
+    # decode/prefill/verify samples + the synthetic peer's, each
+    # host-tagged, plus the fleet rollup of the family
+    'fleet_device_phase_seconds_count{device="cpu:0",'
+    'phase="decode_tick",host="workerA"}',
+    'fleet_device_phase_seconds_count{device="cpu:0",'
+    'phase="prefill",host="workerA"}',
+    'fleet_device_phase_seconds_count{device="cpu:0",'
+    'phase="verify",host="workerA"}',
+    'fleet_device_phase_seconds_count{device="cpu:0",'
+    'phase="decode_tick",host="workerB"}',
+    'fleet_device_phase_seconds_count{device="cpu:0",'
+    'phase="decode_tick",host="fleet"}',
+    # the on-demand XProf capture summary beacons fleet-wide (the raw
+    # trace stays a host-local artifact)
+    'fleet_xprof_captures_total{host="workerA"}',
+    # cross-worker trace store: the aggregator's own gauges
+    "fleet_trace_store_traces",
+    "fleet_trace_store_spans",
+    "fleet_trace_store_rooted",
+]
+
+# Predictive-autoscaling series (ISSUE 13): the forecaster below runs
+# a synthetic backlog ramp through the REAL fit/publish path, so the
+# prediction gauges carry live values; chaos_smoke asserts the
+# end-to-end pre-warm against a real ramp.
+FORECAST_SERIES = [
+    'fleet_autoscale_forecast{signal="slope"}',
+    'fleet_autoscale_forecast{signal="backlog"}',
+    'fleet_autoscale_forecast{signal="breach_s"}',
+    "fleet_autoscale_prewarms_total",
 ]
 
 #: one complete cross-component request trace must carry all of these
@@ -290,17 +324,31 @@ def main() -> int:
                 problems.append(f"generation request {i}: {e}")
         # one solo request with an empty queue: the scheduler must
         # fuse its 4 ticks into ONE lax.scan dispatch (k=4) and poll
-        # the host once for it
+        # the host once for it.  The on-demand XProf trigger is armed
+        # around it: the next measured dispatch runs under a REAL
+        # jax.profiler capture whose summary lands on the registry
+        # (and so on every beacon) while the raw trace stays local.
+        prof = telemetry.get_profiler()
+        xprof_captures = registry.counter("fleet_xprof_captures_total")
+        xc0 = xprof_captures.value
         syncs_before = syncs.value
-        try:
-            gs.submit(np.asarray([4, 3, 2, 1], np.int32), n_new=4,
-                      timeout=300)
-        except Exception as e:  # pragma: no cover - smoke surface
-            problems.append(f"solo scan request: {e}")
+        with tempfile.TemporaryDirectory() as xprof_dir:
+            prof.request_xprof(xprof_dir, dispatches=1)
+            try:
+                gs.submit(np.asarray([4, 3, 2, 1], np.int32), n_new=4,
+                          timeout=300)
+            except Exception as e:  # pragma: no cover - smoke surface
+                problems.append(f"solo scan request: {e}")
         if syncs.value - syncs_before != 1:
             problems.append(
                 f"solo 4-token request cost {syncs.value - syncs_before}"
                 " host syncs (expected 1 fused k=4 scan)")
+        if xprof_captures.value - xc0 != 1:
+            problems.append("on-demand XProf trigger did not complete "
+                            "exactly one capture")
+        if registry.gauge("fleet_xprof_capture_files").value < 1:
+            problems.append("XProf capture summary reports no files "
+                            "written")
     if retired.value - retired_before != 4:
         problems.append(f"generation_server_retired_total grew "
                         f"{retired.value - retired_before} != 4")
@@ -401,21 +449,68 @@ def main() -> int:
             "tracked spans left open after every request retired: "
             f"{[s.name for s in tracer.open_spans()]}")
 
+    # -- predictive autoscaling: a synthetic backlog ramp through the
+    # REAL forecaster fit/publish path — the prediction gauges carry
+    # live values on the scrape, and the math is checked against the
+    # known ramp (backlog = 2t, threshold 20, at t=5 -> breach in 5s)
+    from deeplearning4j_tpu.serving import BacklogForecaster
+    fc = BacklogForecaster(window_s=60.0, min_points=4)
+    for t in range(6):
+        fc.observe(float(t), 2.0 * t)
+    breach = fc.breach_s(20.0)
+    if breach is None or abs(breach - 5.0) > 1e-6:
+        problems.append(f"forecast on the synthetic ramp predicted "
+                        f"{breach}s to breach, expected 5.0s")
+    # the prewarm counter exists on every process that imports the
+    # autoscaler (unlabeled counter exposes at 0; chaos_smoke asserts
+    # the live pre-warm)
+    registry.counter("fleet_autoscale_prewarms_total")
+
     # -- fleet observability plane: TWO workers' beacons aggregate
-    # into ONE scrape with {host=} tags and fleet rollups -----------
+    # into ONE scrape with {host=} tags and fleet rollups; the same
+    # beacons carry closed request spans the aggregator's trace store
+    # stitches into ONE submit -> retire tree per request ------------
     worker_b = telemetry.MetricsRegistry()
     worker_b.counter("generation_server_retired_total").inc(2)
     worker_b.counter("fleet_requests_total",
                      labelnames=("tenant", "outcome")).labels(
                          tenant="hot", outcome="admitted").inc(3)
+    worker_b.histogram("fleet_device_phase_seconds",
+                       labelnames=("device", "phase")).labels(
+                           device="cpu:0",
+                           phase="decode_tick").observe(0.003)
     with tempfile.TemporaryDirectory() as d:
         with telemetry.MetricsBeacon(d, host="workerA",
                                      interval_s=60.0):
             pass                 # start + final publish
         telemetry.publish_beacon(d, "workerB", registry=worker_b)
         fleet_view = telemetry.FleetRegistry(d, stale_after_s=3600.0)
-        obs_body = scrape_body(telemetry, fleet_view)
+        with telemetry.start_metrics_server(fleet_view, port=0) as srv:
+            obs_body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+            ).read().decode()
+            tr_body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/traces?id="
+                f"{fleet_trace_id}", timeout=5).read().decode()
     problems += missing_series(obs_body, FLEET_OBS_SERIES)
+    tree = json.loads(tr_body)
+    if not tree.get("root") or tree["root"]["name"] != "request":
+        problems.append("fleet trace store has no stitched root for "
+                        f"trace {fleet_trace_id}")
+    else:
+        def _names(node):
+            out = {node["name"]}
+            for c in node["children"]:
+                out |= _names(c)
+            return out
+        got = _names(tree["root"])
+        if not {"request/admission", "request/prefill",
+                "request/decode"} <= got:
+            problems.append(
+                f"stitched fleet trace missing phases: {sorted(got)}")
+        if tree["orphans"]:
+            problems.append("stitched fleet trace left orphan "
+                            f"fragments: {tree['orphans']}")
     retired_roll = retired.value + 2
     for line in obs_body.splitlines():
         if line.startswith('generation_server_retired_total'
@@ -488,8 +583,18 @@ def main() -> int:
         "generation_server_host_syncs_total",
         'generation_server_scan_ticks_total{k="4"}',
         "generation_server_tokens_per_dispatch",
+        # continuous device-phase profile (ISSUE 13): the serve/spec
+        # runs above sampled all three serve phases on this process
+        'fleet_device_phase_seconds_bucket{device="cpu:0",'
+        'phase="decode_tick"',
+        'fleet_device_phase_seconds_bucket{device="cpu:0",'
+        'phase="prefill"',
+        'fleet_device_phase_seconds_bucket{device="cpu:0",'
+        'phase="verify"',
+        "fleet_xprof_captures_total",
+        "fleet_xprof_capture_files",
     ] + PAGED_KV_SERIES + SPEC_SERIES + FLEET_SERIES \
-      + RESILIENCE_SERIES + ANALYSIS_SERIES
+      + RESILIENCE_SERIES + ANALYSIS_SERIES + FORECAST_SERIES
     problems += missing_series(body, required)
     if lat.count - lat_before != 16:
         problems.append(

@@ -1,11 +1,16 @@
-"""Fleet observability plane (ISSUE 12): FleetRegistry merge
+"""Fleet observability plane (ISSUE 12 + 13): FleetRegistry merge
 semantics (counter deltas, reset epochs, gauge last-write +
 staleness, histogram bucket merge == pooled-sample quantiles), the
 beacon transport, tracked-span tracing (cross-thread close,
-close-on-owner-death), autoscaler hysteresis (flapping load must not
-flap replicas), the CONC-rule visibility probe over telemetry/fleet.py,
-and the real 2-OS-process aggregated scrape + cross-component request
-trace (slow)."""
+close-on-owner-death), the cross-worker FleetTraceStore stitching
+matrix (out-of-order arrival, duplicate delivery, missing-parent
+orphan policy, owner-death-flushed spans reaching the beacon
+stream), the sampling DeviceProfiler + on-demand XProf trigger,
+predictive-autoscaler forecast math and pre-warm ordering, autoscaler
+hysteresis (flapping load must not flap replicas), the CONC-rule
+visibility probes over telemetry/{fleet,profiling}.py and the
+forecast path, and the real 2-OS-process aggregated scrape +
+cross-HOST stitched request trace (slow)."""
 import json
 import math
 import os
@@ -18,11 +23,17 @@ import urllib.request
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.telemetry import (FleetRegistry, MetricsBeacon,
+from deeplearning4j_tpu.telemetry import (DeviceProfiler,
+                                          FleetRegistry,
+                                          FleetTraceStore,
+                                          MetricsBeacon,
                                           MetricsRegistry, SpanTracer,
                                           publish_beacon)
 from deeplearning4j_tpu.serving.autoscale import (AutoscalePolicy,
-                                                  Autoscaler)
+                                                  Autoscaler,
+                                                  BacklogForecaster,
+                                                  fit_trend,
+                                                  predict_breach_s)
 
 WORKERS = os.path.join(os.path.dirname(__file__), "workers")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -236,6 +247,288 @@ def test_disabled_tracer_begin_is_noop():
 
 
 # ---------------------------------------------------------------------------
+# FleetTraceStore: cross-worker trace stitching matrix (ISSUE 13)
+# ---------------------------------------------------------------------------
+def _host_fragment(names, trace="r-1", root=None, t0=0.0):
+    """Simulate one host's closed request spans on a fresh tracer:
+    ``root`` (if given) opens first and closes last, the ``names``
+    nest inside it sequentially.  Returns the trace-tagged tail a
+    beacon would ship."""
+    tr = SpanTracer()
+    spans = []
+    if root is not None:
+        spans.append(tr.begin(root, trace=trace))
+    for name in names:
+        sp = tr.begin(name, trace=trace)
+        time.sleep(0.001)
+        sp.end()
+    if root is not None:
+        time.sleep(0.001)
+        spans[0].end(outcome="ok")
+    return tr.trace_events()
+
+
+def test_trace_store_stitches_cross_host_fragments():
+    """host A holds the submit->retire root, host B a handoff
+    fragment: ONE tree, B's top node under A's root, ordered by
+    wall clock, no orphans."""
+    st = FleetTraceStore()
+    st.ingest("hostA", _host_fragment(
+        ["request/admission", "request/placement"], root="request"))
+    st.ingest("hostB", _host_fragment(
+        ["request/replica_queue", "request/prefill", "request/decode"],
+        root="request/handoff"))
+    tree = st.tree("r-1")
+    assert tree["complete"] and not tree["orphans"]
+    assert tree["hosts"] == ["hostA", "hostB"]
+    root = tree["root"]
+    assert root["name"] == "request" and root["host"] == "hostA"
+    kids = {c["name"]: c for c in root["children"]}
+    assert set(kids) == {"request/admission", "request/placement",
+                         "request/handoff"}
+    hb = kids["request/handoff"]
+    assert hb["host"] == "hostB"
+    assert {c["name"] for c in hb["children"]} == {
+        "request/replica_queue", "request/prefill", "request/decode"}
+
+
+def test_trace_store_out_of_order_arrival_promotes_orphans():
+    """The child fragment landing BEFORE its root is an orphan (the
+    missing-parent policy — reported, never guessed into a fabricated
+    parent); the root arriving later promotes it into the tree on the
+    next query.  Assembly is pure, so arrival order cannot corrupt."""
+    st = FleetTraceStore()
+    frag_b = _host_fragment(["request/decode"], root="request/handoff")
+    frag_a = _host_fragment(["request/admission"], root="request")
+    st.ingest("hostB", frag_b)
+    early = st.tree("r-1")
+    assert early["root"] is None and not early["complete"]
+    assert [n["name"] for n in early["orphans"]] == ["request/handoff"]
+    assert st.summary()["rooted"] == 0
+    st.ingest("hostA", frag_a)          # the root fragment arrives
+    late = st.tree("r-1")
+    assert late["complete"] and late["root"]["name"] == "request"
+    assert {c["name"] for c in late["root"]["children"]} == {
+        "request/admission", "request/handoff"}
+    assert st.summary()["rooted"] == 1
+
+
+def test_trace_store_duplicate_delivery_is_idempotent():
+    """A beacon re-delivering the same tail (every publish ships the
+    window) must not duplicate spans — the (host, seq) dedup."""
+    st = FleetTraceStore()
+    frag = _host_fragment(["request/decode"], root="request")
+    assert st.ingest("hostA", frag) == 2
+    assert st.ingest("hostA", frag) == 0
+    assert st.tree("r-1")["spans"] == 2
+    # the SAME events from another host are a different fragment
+    # (seq spaces are per-host) — counted, not deduped away
+    assert st.ingest("hostB", frag) == 2
+
+
+def test_trace_store_ignores_untraced_events_and_bounds_traces():
+    st = FleetTraceStore(max_traces=2)
+    tr = SpanTracer()
+    with tr.span("serve/tick", k=4):
+        pass                           # no trace arg: host-local
+    assert st.ingest("hostA", tr.events()) == 0
+    for i in range(3):
+        st.ingest("hostA", _host_fragment([], trace=f"t-{i}",
+                                          root="request"))
+    assert len(st.trace_ids()) == 2    # oldest evicted
+    assert "t-0" not in st.trace_ids()
+
+
+def test_owner_death_flushed_spans_reach_the_beacon_stream(tmp_path):
+    """The satellite fix, end to end without a fleet: a bound span
+    flushed by end_owned_by AND an unbound request span closed by a
+    recovery thread must BOTH land in trace_events, ship in a real
+    beacon file, and stitch in the aggregator's store — a recovered
+    request still forms a complete fleet trace."""
+    tr = SpanTracer()
+    root = tr.begin("request", trace="r-rec")
+    tr.begin("request/decode", bound=True, owner=("sched", 0),
+             trace="r-rec")
+    # the scheduler hangs; the watchdog flushes its bound spans
+    assert tr.end_owned_by(("sched", 0), error="watchdog_recovery") == 1
+    closer = threading.Thread(target=lambda: root.end(outcome="ok"))
+    closer.start()
+    closer.join()                      # recovery thread retires it
+    evs = tr.trace_events()
+    assert {e["name"] for e in evs} == {"request", "request/decode"}
+    reg = MetricsRegistry()
+    publish_beacon(tmp_path, "hostR", registry=reg, trace_events=evs)
+    fr = FleetRegistry(tmp_path, stale_after_s=60)
+    fr.refresh()
+    tree = fr.traces.tree("r-rec")
+    assert tree["complete"]
+    decode = tree["root"]["children"][0]
+    assert decode["args"]["error"] == "watchdog_recovery"
+
+
+# ---------------------------------------------------------------------------
+# DeviceProfiler: sampling fold, top-K summary, XProf trigger
+# ---------------------------------------------------------------------------
+def test_device_profiler_folds_samples_and_ranks_topk():
+    reg = MetricsRegistry()
+    prof = DeviceProfiler(reg)
+    for _ in range(3):
+        with prof.measure("decode_tick"):
+            pass
+    prof.observe("prefill", 0.5)
+    prof.observe("prefill", 0.7)
+    top = prof.top_ops(k=1)
+    assert top[0]["phase"] == "prefill"       # 1.2s total dominates
+    assert top[0]["samples"] == 2
+    fam = reg.get("fleet_device_phase_seconds")
+    assert fam.labelnames == ("device", "phase")
+    assert fam.labels(device=prof.device(),
+                      phase="decode_tick").state()[3] == 3
+
+
+def test_device_profiler_sampling_skips_and_ready_noop():
+    """every=3 measures 1-in-3 calls (the skip counter carries the
+    rest); ready() on an unsampled measure must not block-sync."""
+    reg = MetricsRegistry()
+    prof = DeviceProfiler(reg)
+    synced = []
+    for _ in range(6):
+        with prof.measure("optimizer_step", every=3) as m:
+            if m.sampled:
+                synced.append(1)
+            m.ready(None)              # None tree: never imports jax
+    fam = reg.get("fleet_device_phase_seconds")
+    assert fam.labels(device=prof.device(),
+                      phase="optimizer_step").state()[3] == 2
+    assert sum(synced) == 2
+    assert reg.get("fleet_device_phase_skipped_total").labels(
+        phase="optimizer_step").value == 4
+
+
+def test_xprof_trigger_captures_window_and_summarizes(tmp_path,
+                                                      monkeypatch):
+    """request_xprof arms the NEXT dispatches: start_trace fires once,
+    stop_trace after the requested window, and the summary gauges
+    (files/bytes/captures) land on the registry — the part that
+    beacons.  A second request while armed is ignored."""
+    import jax
+    calls = []
+
+    def fake_start(d):
+        calls.append(("start", d))
+        with open(os.path.join(d, "trace.xplane.pb"), "wb") as f:
+            f.write(b"x" * 128)
+
+    monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop",)))
+    reg = MetricsRegistry()
+    prof = DeviceProfiler(reg)
+    prof.request_xprof(tmp_path, dispatches=2)
+    prof.request_xprof(tmp_path / "other")     # ignored while armed
+    assert prof.xprof_armed()
+    for _ in range(3):
+        with prof.measure("decode_tick"):
+            pass
+    assert [c[0] for c in calls] == ["start", "stop"]
+    assert not prof.xprof_armed()
+    assert reg.get("fleet_xprof_captures_total").value == 1
+    assert reg.get("fleet_xprof_capture_files").value == 1
+    assert reg.get("fleet_xprof_capture_bytes").value == 128
+    # the capture forced sampling: all 3 dispatches were measured or
+    # skipped without losing the armed window's 2
+    fam = reg.get("fleet_device_phase_seconds")
+    assert fam.labels(device=prof.device(),
+                      phase="decode_tick").state()[3] >= 2
+
+
+# ---------------------------------------------------------------------------
+# Predictive autoscaling: forecast math + pre-warm ordering
+# ---------------------------------------------------------------------------
+def test_forecast_math_on_synthetic_ramp():
+    """backlog = 2t, threshold 20: at t=5 the fitted value is 10 and
+    the slope 2, so the breach is exactly 5s out.  Flat and shrinking
+    trends project no breach; an exceeded threshold projects 0."""
+    ramp = [(float(t), 2.0 * t) for t in range(6)]
+    slope, v_now = fit_trend(ramp)
+    assert slope == pytest.approx(2.0)
+    assert v_now == pytest.approx(10.0)
+    assert predict_breach_s(ramp, 20.0) == pytest.approx(5.0)
+    assert predict_breach_s([(t, 5.0) for t in range(5)], 20.0) is None
+    assert predict_breach_s([(t, 20.0 - t) for t in range(5)],
+                            20.0) is None
+    assert predict_breach_s(ramp, 9.0) == 0.0
+    assert fit_trend([(1.0, 3.0)]) is None
+
+
+def test_forecaster_window_prunes_and_publishes():
+    fc = BacklogForecaster(window_s=4.0, min_points=3)
+    for t in range(10):
+        fc.observe(float(t), 2.0 * t)
+    # only t in [5, 9] is in-window: still the same 2/s ramp
+    assert fc.breach_s(28.0) == pytest.approx(5.0)
+    fc2 = BacklogForecaster(window_s=10.0, min_points=5)
+    fc2.observe(0.0, 1.0)
+    assert fc2.breach_s(10.0) is None          # window too thin
+
+
+def test_predictive_prewarm_fires_before_reactive_signal():
+    """A ramping backlog with every reactive signal quiet must scale
+    up on the forecast alone — and count it as a pre-warm.  The
+    reactive wait target is far above anything observed, so any up
+    action here IS 'replica added before the reactive breach'."""
+    from deeplearning4j_tpu import telemetry as _t
+    reg = MetricsRegistry()
+    fleet = _FakeFleet(reg)
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                          queue_wait_p99_target_s=1e9,
+                          queue_depth_high=100,
+                          forecast_horizon_s=30.0,
+                          forecast_window_s=60.0,
+                          forecast_min_points=3,
+                          up_consecutive=2, cooldown_s=0.0)
+    sc = Autoscaler(fleet, pol, source=reg)
+    prewarm = _t.get_registry().counter(
+        "fleet_autoscale_prewarms_total")
+    pw0 = prewarm.value
+    acts = []
+    for i in range(6):
+        reg.gauge("fleet_queue_depth").set(5.0 * i)   # 5/s ramp
+        acts.append(sc.evaluate(now=100.0 + i))
+    assert "up" in acts
+    assert fleet.adds == [1]
+    assert prewarm.value - pw0 == 1
+    fc = _t.get_registry().get("fleet_autoscale_forecast")
+    assert fc.labels(signal="slope").value == pytest.approx(5.0, rel=0.2)
+    assert fc.labels(signal="breach_s").value >= 0
+
+
+def test_forecast_respects_hysteresis_no_single_eval_flap():
+    """One firing forecast evaluation must NOT scale (up_consecutive
+    gates the prediction exactly like the reactive signals)."""
+    reg = MetricsRegistry()
+    fleet = _FakeFleet(reg)
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                          queue_wait_p99_target_s=1e9,
+                          queue_depth_high=100,
+                          forecast_horizon_s=30.0,
+                          forecast_min_points=3,
+                          up_consecutive=3, cooldown_s=0.0)
+    sc = Autoscaler(fleet, pol, source=reg)
+    for i in range(4):                 # ramp: builds points + streak
+        reg.gauge("fleet_queue_depth").set(10.0 * i)
+        assert sc.evaluate(now=100.0 + i) == "hold"
+    assert fleet.adds == []            # streak 2 of 3: still held
+    reg.gauge("fleet_queue_depth").set(40.0)
+    assert sc.evaluate(now=104.0) == "up"
+
+
+def test_forecast_requires_depth_ceiling():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(forecast_horizon_s=5.0)
+
+
+# ---------------------------------------------------------------------------
 # Autoscaler hysteresis (no jax, fake fleet, isolated registry)
 # ---------------------------------------------------------------------------
 class _FakeFleet:
@@ -436,6 +729,41 @@ def test_conc_rules_see_telemetry_fleet():
     assert findings == [], [f.render() for f in findings]
 
 
+def test_conc_rules_see_profiler_store_and_forecast_path():
+    """Satellite (ISSUE 13): the whole-package lint must SEE the new
+    shared-state owners — DeviceProfiler's sampling/XProf state, the
+    FleetTraceStore, the BacklogForecaster's shared window — and
+    produce ZERO findings for them (new threads + shared windows are
+    exactly its ROADMAP-item-5 blind-spot list)."""
+    from deeplearning4j_tpu.analysis import concurrency_lint, package_index
+    from deeplearning4j_tpu import serving as _serving
+    from deeplearning4j_tpu import telemetry as _telemetry
+    findings = []
+    for pkgmod, fname, cls, attrs in (
+            (_telemetry, "telemetry/profiling.py", "DeviceProfiler",
+             ("_calls", "_xprof_dir", "_xprof_left")),
+            (_telemetry, "telemetry/tracing.py", "FleetTraceStore",
+             ("_traces",)),
+            # the forecaster's window deque mutates via method calls
+            # (append/popleft) — guarded-store inference only counts
+            # plain attribute stores, so assert its lock + the
+            # zero-findings bar below
+            (_serving, "serving/autoscale.py", "BacklogForecaster",
+             ())):
+        pkg = os.path.dirname(pkgmod.__file__)
+        index, _pf, _stats = package_index.build_index(pkg, root=REPO)
+        mods = [m for m, s in index.modules.items()
+                if s["path"].endswith(fname)]
+        assert mods, f"{fname} missing from the index"
+        facts = index.class_facts(mods[0], cls)
+        assert "_lock" in facts["lock_attrs"], (cls, facts)
+        for attr in attrs:
+            assert attr in facts["guarded"], (cls, attr, facts)
+        findings += [f for f in concurrency_lint.lint_package(index)
+                     if f.path.endswith(fname)]
+    assert findings == [], [f.render() for f in findings]
+
+
 # ---------------------------------------------------------------------------
 # The acceptance bar: a REAL 2-OS-process fleet run -> ONE aggregated
 # scrape with both hosts tagged + rollups, and a complete
@@ -470,18 +798,51 @@ def test_two_process_fleet_aggregated_scrape_and_trace(tmp_path):
         body = urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}/metrics", timeout=10
         ).read().decode()
+        handoff_id = json.load(
+            open(tmp_path / "handoff.json"))["trace_id"]
+        tr_body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/traces?id={handoff_id}",
+            timeout=10).read().decode()
+        idx_body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/traces", timeout=10
+        ).read().decode()
     for host in ("host000", "host001"):
         assert f'fleet_host_up{{host="{host}"}} 1.0' in body
-        assert (f'generation_server_retired_total{{host="{host}"}} 3.0'
+        assert (f'generation_server_retired_total{{host="{host}"}} 4.0'
                 in body)
+        # continuous device profiling: every host's decode/prefill
+        # samples arrive host-tagged on the ONE scrape
+        for phase in ("decode_tick", "prefill"):
+            assert (f'fleet_device_phase_seconds_count{{device="cpu:0"'
+                    f',phase="{phase}",host="{host}"}}') in body, phase
     # fleet rollup sums the workers
-    assert 'generation_server_retired_total{host="fleet"} 6.0' in body
+    assert 'generation_server_retired_total{host="fleet"} 8.0' in body
     assert ('fleet_request_phase_seconds_count{phase="decode",'
-            'host="fleet"} 6.0') in body
+            'host="fleet"} 8.0') in body
+    assert ('fleet_device_phase_seconds_count{device="cpu:0",'
+            'phase="decode_tick",host="fleet"}') in body
+    # the trace store is on the scrape and holds stitched traces
+    assert "fleet_trace_store_traces" in body
+    # THE acceptance bar: the handed-off request (one request, two
+    # hosts) is exactly ONE submit -> retire tree — host000's root
+    # with host001's handoff fragment nested under it
+    tree = json.loads(tr_body)
+    assert tree["complete"], tree
+    assert tree["hosts"] == ["host000", "host001"]
+    root = tree["root"]
+    assert root["name"] == "request" and root["host"] == "host000"
+    handoffs = [c for c in root["children"]
+                if c["name"] == "request/handoff"]
+    assert len(handoffs) == 1 and handoffs[0]["host"] == "host001"
+    hnames = {c["name"] for c in handoffs[0]["children"]}
+    assert {"request/replica_queue", "request/prefill",
+            "request/decode"} <= hnames, hnames
+    assert handoff_id in json.loads(idx_body)["trace_ids"]
     # per-worker summaries cross-check the scrape against ground truth
     for rank in range(2):
         doc = json.load(open(tmp_path / f"obs_rank{rank}.json"))
-        assert doc["retired"] == 3
+        assert doc["retired"] == 4
+        assert "prefill" in doc["device_phases"]
     # the cross-component request trace artifact: submit -> retire
     # with per-phase timings, all stamped with ONE trace id
     evs = [json.loads(l) for l in

@@ -1,16 +1,25 @@
 """Fleet-observability worker: one serving host of a 2-OS-process
-fleet.  Serves 3 requests through a 1-replica ServingFleet while a
-MetricsBeacon pushes its registry into the shared out_dir; rank 0
-additionally exports ONE request's cross-component trace (submit ->
-retire, every span stamped with the fleet-minted trace id).  The
-parent test aggregates the beacon FILES into one scrape and asserts
-both hosts + rollups + the complete trace from the artifacts alone.
+fleet.  Serves 4 requests through a 1-replica ServingFleet while a
+MetricsBeacon pushes its registry AND its closed request spans into
+the shared out_dir; rank 0 additionally exports ONE request's
+cross-component trace (submit -> retire, every span stamped with the
+fleet-minted trace id) and HANDS ONE TRACE OFF: it publishes a
+handoff file naming a trace id, and rank 1 serves one of its requests
+under that id (``submit_async(trace_id=...)`` — the cross-host
+migration/handoff path), so the parent's FleetTraceStore must stitch
+fragments from BOTH hosts into ONE submit -> retire tree.  The
+continuous device profiler runs implicitly at the decode/prefill
+dispatch sites, so each host's beacon carries
+``fleet_device_phase_seconds{device=,phase=}`` samples.  The parent
+test aggregates the beacon FILES into one scrape and asserts both
+hosts + rollups + the stitched trace from the artifacts alone.
 
 Usage: obs_worker.py <rank> <out_dir>
 """
 import json
 import os
 import sys
+import time
 
 os.environ.setdefault("XLA_FLAGS", "")
 import jax
@@ -21,6 +30,7 @@ import numpy as np  # noqa: E402
 
 rank, out_dir = int(sys.argv[1]), sys.argv[2]
 host = f"host{rank:03d}"
+HANDOFF = os.path.join(out_dir, "handoff.json")
 
 from deeplearning4j_tpu import telemetry  # noqa: E402
 from deeplearning4j_tpu.serving import ServingFleet  # noqa: E402
@@ -36,10 +46,35 @@ gpt = Gpt(vocab_size=50, max_len=32, d_model=32, n_layers=2,
 with ServingFleet(gpt, n_replicas=1, n_slots=2, max_len=32,
                   block_size=4, tick_timeout_s=None) as fleet:
     p = np.asarray([1, 2, 3, 4], np.int32)
-    hs = [fleet.submit_async(p, n_new=6, tenant="hot",
-                             deadline_s=300.0) for _ in range(3)]
-    outs = [h.result(timeout=300) for h in hs]
-    trace_id = hs[0].trace_id
+    if rank == 0:
+        # 4 requests: the prefill profiler samples 1-in-4 admissions,
+        # so every rank's beacon must carry >= 1 prefill sample
+        hs = [fleet.submit_async(p, n_new=6, tenant="hot",
+                                 deadline_s=300.0) for _ in range(4)]
+        outs = [h.result(timeout=300) for h in hs]
+        trace_id = hs[0].trace_id
+        # hand the LAST request's trace to rank 1: its fleet
+        # residence there continues this id (atomic publish so the
+        # peer never reads a torn file)
+        from deeplearning4j_tpu.resilience.coordination import (
+            atomic_publish_json)
+        atomic_publish_json(HANDOFF, {"trace_id": hs[3].trace_id})
+    else:
+        hs = [fleet.submit_async(p, n_new=6, tenant="hot",
+                                 deadline_s=300.0) for _ in range(3)]
+        outs = [h.result(timeout=300) for h in hs]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not os.path.exists(HANDOFF):
+            time.sleep(0.05)
+        doc = json.load(open(HANDOFF))
+        # the handed-off request: SAME trace id, local root
+        # request/handoff — the parent's trace store stitches this
+        # host's fragment under host000's submit -> retire root
+        hh = fleet.submit_async(p, n_new=6, tenant="hot",
+                                deadline_s=300.0,
+                                trace_id=doc["trace_id"])
+        outs.append(hh.result(timeout=300))
+        trace_id = hh.trace_id
 assert all(o.shape == (10,) for o in outs), [o.shape for o in outs]
 leaked = telemetry.get_tracer().open_spans()
 assert not leaked, [(s.name, s.args) for s in leaked]
@@ -48,9 +83,15 @@ if rank == 0:
     telemetry.get_tracer().export_jsonl(
         os.path.join(out_dir, "trace_rank0.jsonl"), trace_id=trace_id)
 
+# ground truth for the parent: the scrape must agree with these
 retired = reg.counter("generation_server_retired_total").value
+phases = sorted({lv[1] for lv, _c in reg.histogram(
+    "fleet_device_phase_seconds",
+    labelnames=("device", "phase"))._items()})
 with open(os.path.join(out_dir, f"obs_rank{rank}.json"), "w") as f:
     json.dump({"rank": rank, "host": host, "retired": retired,
-               "trace_id": trace_id}, f)
+               "trace_id": trace_id, "device_phases": phases,
+               "handoff_trace": json.load(open(HANDOFF))["trace_id"]},
+              f)
 beacon.close()                       # final totals land in the beacon
 print("OBS_WORKER_OK", rank)
