@@ -770,6 +770,234 @@ def bench_serving_fleet(replica_ladder=(1, 2, 4), n_slots=8,
                     "replica's prefix cache"}
 
 
+def bench_serving_disagg(n_replicas=2, n_slots=8, long_len=384,
+                         short_len=16, n_new_long=32, n_new_short=64,
+                         n_long=8, n_short=16, block_size=16,
+                         tick_batch=8, smoke=False):
+    """Disaggregated prefill/decode + tiered KV bench ->
+    SERVING_DISAGG_r14.json (ISSUE 14).  Two measurements:
+
+    1. MIXED TRACE — long-prompt admissions interleaved with
+       short-prompt decode streams through (a) a unified fleet
+       (every replica prefills AND decodes: a long admission stalls
+       that replica's decode ticks behind its compute-bound prefill)
+       and (b) a role-split fleet (longs stage through the prefill
+       replica, handing their finished prefix blocks to the decode
+       replica; shorts never wait behind a long prefill).  Reported:
+       short-stream TTFT p50/p99 under both, long TTFT, aggregate
+       tokens/s.  Acceptance: disagg short p99 <= unified short p99.
+    2. TIERED PREFIX CACHE — a prefix footprint LARGER than the
+       device pool, landed via the handoff/import path so every
+       measured admission restores its blocks from the host tier
+       with one batched H2D (``nfill`` deterministic -> no compile
+       jitter in-window): tier-hit TTFT vs the cold full re-prefill
+       of same-length fresh prompts.  Acceptance: tier-hit TTFT <
+       cold re-prefill TTFT.
+
+    Outputs are byte-checked in-window: the disagg fleet's decode of
+    the probe prompt must equal the unified fleet's.  ``smoke=True``
+    shrinks to the tiny CPU config (the artifact CI records); on the
+    shared-host CPU the fleets contend for one core, so the disagg
+    win is scheduler-serialization relief, not chip isolation — the
+    TPU geometry is where the split maps to real chips."""
+    import jax
+    from deeplearning4j_tpu.parallel import GenerationServer
+    from deeplearning4j_tpu.serving import ServingFleet
+    from deeplearning4j_tpu.zoo.gpt import Gpt
+
+    if smoke:
+        n_slots, long_len, short_len = 2, 44, 4
+        n_new_long, n_new_short = 4, 12
+        n_long, n_short, block_size = 6, 12, 4
+        m = Gpt(vocab_size=50, max_len=64, d_model=32, n_layers=2,
+                n_heads=4, d_ff=64, seq_len=8, compute_dtype=None,
+                seed=3)
+        compute_dtype = None
+    else:
+        if jax.default_backend() not in ("tpu",):
+            raise RuntimeError(
+                "serving_disagg bench requires a TPU backend "
+                "(smoke=True for the CPU config)")
+        m = Gpt(seq_len=long_len, max_len=long_len + n_new_long)
+        compute_dtype = "bfloat16"
+    net = m.init_graph()
+    max_len = max(long_len + n_new_long, short_len + n_new_short)
+    rng = np.random.default_rng(0)
+    vocab = m.vocab_size
+
+    def long_prompt():
+        return rng.integers(0, vocab, long_len).astype(np.int32)
+
+    def short_prompt():
+        return rng.integers(0, vocab, short_len).astype(np.int32)
+
+    def pct(vals, q):
+        vals = [v for v in vals if v is not None]
+        return round(float(np.percentile(vals, q)), 4) if vals else None
+
+    def run_trace(fleet):
+        """Interleave long admissions into a stream of shorts; returns
+        (short ttfts, long ttfts, tokens/s, one probe output)."""
+        # off-window warm: both admission paths + the scan chain on
+        # every replica (throwaway prompts)
+        for i in range(fleet.n_replicas):
+            srv = fleet.replica(i)
+            srv.submit(long_prompt(), n_new=2)
+            srv.submit(short_prompt(), n_new=2)
+        fleet.submit(long_prompt(), n_new=2)     # fleet path (handoff
+        fleet.submit(short_prompt(), n_new=2)    # compile, disagg)
+        probe = long_prompt()
+        handles, kinds = [], []
+        t0 = time.perf_counter()
+        li = 0
+        for i in range(n_short):
+            handles.append(fleet.submit_async(short_prompt(),
+                                              n_new=n_new_short))
+            kinds.append("short")
+            if i % 2 == 0 and li < n_long:
+                p = probe if li == 0 else long_prompt()
+                handles.append(fleet.submit_async(p,
+                                                  n_new=n_new_long))
+                kinds.append("long")
+                li += 1
+        outs = [h.result(timeout=600) for h in handles]
+        dt = time.perf_counter() - t0
+        n_toks = sum(n_new_short if k == "short" else n_new_long
+                     for k in kinds)
+        shorts = [h.ttft for h, k in zip(handles, kinds)
+                  if k == "short"]
+        longs = [h.ttft for h, k in zip(handles, kinds) if k == "long"]
+        probe_out = next(o for o, k in zip(outs, kinds) if k == "long")
+        return shorts, longs, n_toks / dt, probe_out
+
+    common = dict(n_slots=n_slots, max_len=max_len,
+                  compute_dtype=compute_dtype, block_size=block_size,
+                  tick_batch=tick_batch, tick_timeout_s=None)
+    rng = np.random.default_rng(7)
+    with ServingFleet(net, n_replicas=n_replicas, **common) as fleet:
+        (uni_short, uni_long, uni_tps, uni_probe) = run_trace(fleet)
+    rng = np.random.default_rng(7)     # identical trace
+    roles = ["prefill"] + ["decode"] * (n_replicas - 1)
+    with ServingFleet(net, n_replicas=n_replicas, roles=roles,
+                      **common) as fleet:
+        (dis_short, dis_long, dis_tps, dis_probe) = run_trace(fleet)
+    if not np.array_equal(uni_probe, dis_probe):
+        raise AssertionError(
+            "disaggregated decode diverged from the unified fleet's "
+            "decode of the same prompt")
+
+    # -- tiered prefix cache: footprint >> device pool ----------------
+    # the tier-hit-vs-re-prefill comparison needs prefill COMPUTE to
+    # dominate dispatch overhead (at toy width the paged gather's
+    # extra ops outweigh the saved FLOPs), so the smoke runs this
+    # half on a wider net than the trace half
+    if smoke:
+        tm = Gpt(vocab_size=50, max_len=128, d_model=256, n_layers=2,
+                 n_heads=4, d_ff=1024, seq_len=8, compute_dtype=None,
+                 seed=5)
+        tier_net = tm.init_graph()
+        t_long, t_new, t_bs = 96, 4, 8
+        t_max = t_long + t_new
+    else:
+        tier_net, t_max = net, max_len
+        t_long, t_new, t_bs = long_len, n_new_long, block_size
+    tcommon = dict(n_slots=2, max_len=t_max,
+                   compute_dtype=compute_dtype, block_size=t_bs,
+                   tick_batch=tick_batch, tick_timeout_s=None)
+    full_blocks = (t_long - 1) // t_bs
+    blocks_per = -(-(t_long + t_new) // t_bs)
+    kv_blocks = max(-(-t_max // t_bs),                # >= one max req
+                    blocks_per + 2)
+    n_prefixes = max(3, (2 * kv_blocks) // full_blocks + 1)
+    prefixes = [rng.integers(0, vocab, t_long).astype(np.int32)
+                for _ in range(n_prefixes)]
+    warm_p = rng.integers(0, vocab, t_long).astype(np.int32)
+    # the prefix footprint is built OFF the bench server (a stand-in
+    # prefill replica), then imported — every measured admission
+    # restores full_blocks spilled blocks: deterministic nfill, so
+    # the one in-window compile variant is warmed by the throwaway
+    with GenerationServer(tier_net, **tcommon) as src:
+        payloads = []
+        for p in (warm_p, *prefixes):
+            src.prefill_async(p).result(timeout=600)
+            payloads.append(src.export_prefix(p))
+    with GenerationServer(tier_net, kv_blocks=kv_blocks,
+                          host_tier_blocks=4 * kv_blocks,
+                          **tcommon) as srv:
+        srv.submit(rng.integers(0, vocab, t_long).astype(np.int32),
+                   n_new=t_new)                       # cold compile
+        # warm the tier-hit compile variant with the SAME key the
+        # measured admissions hit (dev_matched=0, nfill=full_blocks):
+        # warm_p was imported but never submitted here, so its
+        # admission restores every block from the tier
+        srv.import_blocks(payloads[0])
+        srv.submit(warm_p, n_new=t_new)
+        for pay in payloads[1:]:
+            srv.import_blocks(pay)
+        hit_ttfts, cold_ttfts = [], []
+        for p in prefixes:
+            h = srv.submit_async(p, n_new=t_new)
+            h.result(timeout=600)
+            hit_ttfts.append(h.ttft)
+        for _ in range(len(prefixes)):
+            h = srv.submit_async(
+                rng.integers(0, vocab, t_long).astype(np.int32),
+                n_new=t_new)
+            h.result(timeout=600)
+            cold_ttfts.append(h.ttft)
+        tier_stats = srv.stats()
+    ttft_tier_hit = float(np.median(hit_ttfts))
+    ttft_cold = float(np.median(cold_ttfts))
+
+    dis_p99 = pct(dis_short, 99)
+    uni_p99 = pct(uni_short, 99)
+    return {"metric": "serving_disagg_prefill_decode",
+            "value": dis_p99, "unit": "short_stream_ttft_p99_s",
+            "model": ("tiny CPU-smoke Gpt" if smoke
+                      else "zoo.Gpt GPT-2-small-shaped"),
+            "smoke": smoke, "n_replicas": n_replicas,
+            "roles": roles, "n_slots": n_slots,
+            "block_size": block_size, "long_len": long_len,
+            "short_len": short_len, "n_long": n_long,
+            "n_short": n_short, "n_new_long": n_new_long,
+            "n_new_short": n_new_short,
+            "unified": {
+                "short_ttft_p50_s": pct(uni_short, 50),
+                "short_ttft_p99_s": uni_p99,
+                "long_ttft_p50_s": pct(uni_long, 50),
+                "long_ttft_p99_s": pct(uni_long, 99),
+                "new_tokens_per_sec": round(uni_tps, 1)},
+            "disagg": {
+                "short_ttft_p50_s": pct(dis_short, 50),
+                "short_ttft_p99_s": dis_p99,
+                "long_ttft_p50_s": pct(dis_long, 50),
+                "long_ttft_p99_s": pct(dis_long, 99),
+                "new_tokens_per_sec": round(dis_tps, 1)},
+            "vs_baseline": round(uni_p99 / dis_p99, 3)
+            if dis_p99 else None,
+            "tier": {
+                "kv_blocks_device": kv_blocks,
+                "prefix_footprint_blocks":
+                    n_prefixes * full_blocks,
+                "ttft_tier_hit_s": round(ttft_tier_hit, 4),
+                "ttft_cold_reprefill_s": round(ttft_cold, 4),
+                "tier_hit_ttft_ratio": round(
+                    ttft_tier_hit / ttft_cold, 4),
+                "tier_fetches": tier_stats["tier_fetches"],
+                "tier_spills": tier_stats["tier_spills"],
+                "host_tier_blocks": tier_stats["host_tier_blocks"]},
+            "parity": "disagg probe byte-checked vs unified in-window",
+            "note": "value is the disagg fleet's short-stream TTFT "
+                    "p99 under the mixed trace; vs_baseline is the "
+                    "unified fleet's p99 over it (>= 1 means the "
+                    "role split kept short streams out of the long "
+                    "admissions' shadow).  tier_hit_ttft_ratio < 1 "
+                    "means reviving a spilled prefix (one batched "
+                    "H2D) beats re-prefilling it, at a prefix "
+                    "footprint of prefix_footprint_blocks >> "
+                    "kv_blocks_device"}
+
+
 def bench_mnist_mlp():
     import jax
     import jax.numpy as jnp
@@ -824,7 +1052,7 @@ def main():
     result["secondary"] = []
     for fn in (bench_bert, bench_bert_imported, bench_gpt,
                bench_serving_decode, bench_speculative,
-               bench_serving_fleet):
+               bench_serving_fleet, bench_serving_disagg):
         try:
             result["secondary"].append(fn())
         except Exception as e:  # secondaries must never sink the primary

@@ -19,6 +19,7 @@ from deeplearning4j_tpu.parallel.mesh import MeshConfig
 from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.generation_server import GenerationServer
+from deeplearning4j_tpu.parallel.kv_tiering import HostKVTier
 from deeplearning4j_tpu.parallel.distributed import (
     global_mesh, host_local_batch_to_global, initialize)
 from deeplearning4j_tpu.parallel.checkpoint import (
@@ -35,7 +36,7 @@ from deeplearning4j_tpu.parallel.pipeline import (
 from deeplearning4j_tpu.parallel.scaling import measure_scaling
 
 __all__ = ["MeshConfig", "ShardedTrainer", "ParallelInference",
-           "GenerationServer",
+           "GenerationServer", "HostKVTier",
            "initialize", "initialize_distributed", "global_mesh",
            "host_local_batch_to_global", "ShardedCheckpointer",
            "CheckpointListener", "ring_attention", "ring_self_attention",

@@ -130,6 +130,23 @@ costs acceptance rate, never parity.  ``generation_server_spec_
 {proposed,accepted}_total`` + the acceptance-rate gauge watch the
 draft's quality in production.
 
+TIERED KV cache (``host_tier_blocks``, PR 14): HBM is the binding
+serving constraint, and an LRU-evicted prefix block used to die —
+capping the effective prefix cache at pool size.  With a host tier
+armed, eviction SPILLS the block's raw bytes to a capacity-bounded
+host-RAM LRU (``kv_tiering.HostKVTier``, keyed by the same chain
+hashes), and an admission whose chain walk runs past the device map
+into the tier restores the spilled blocks with ONE batched H2D inside
+the admit dispatch, then prefills only the still-uncached suffix —
+byte-identical to a device-resident hit, at a block copy instead of a
+re-prefill.  The same store carries DISAGGREGATED prefill/decode
+handoffs: ``prefill_async`` runs admission+prefill and retires without
+a decode tick (the registered prefix blocks are the product),
+``export_prefix`` serializes them (hash + raw token bytes + K/V
+bytes), and ``import_blocks`` lands them in the target replica's tier,
+where the handed-off request's admission restores them exactly like a
+tier hit and re-registers them device-resident for copy-free reuse.
+
 Not here yet (ROADMAP open items): a TP/mesh-sharded tick.
 """
 from __future__ import annotations
@@ -151,6 +168,7 @@ from deeplearning4j_tpu.analysis import sanitize as _sanitize
 from deeplearning4j_tpu.models.generation import (TransformerGenerator,
                                                   _filter_logits_rows)
 from deeplearning4j_tpu.parallel import speculative as _speculative
+from deeplearning4j_tpu.parallel.kv_tiering import HostKVTier
 from deeplearning4j_tpu.parallel.inference import _bucket
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience.errors import (CancelledError,
@@ -253,8 +271,47 @@ _KV_BLK_SHARED = telemetry.counter(
     "block table (prefill skipped for these tokens)")
 _POOL_FREE = telemetry.gauge(
     "kv_pool_blocks_free",
-    "allocatable KV blocks (free list + evictable refcount-0 cache "
-    "entries) — admission queues when a request needs more")
+    "FREE-LIST KV blocks (unclaimed, holding no cache entry).  "
+    "ISSUE 14 split: evictable refcount-0 cache entries are counted "
+    "separately in kv_pool_blocks_evictable — summing them here hid "
+    "imminent spill pressure (a pool can be 100% cache-resident with "
+    "a zero free list and still admit, but every admission then "
+    "evicts/spills)")
+_POOL_EVICTABLE = telemetry.gauge(
+    "kv_pool_blocks_evictable",
+    "refcount-0 prefix-cache blocks resident in the device pool "
+    "(reclaimable by admission; with a host tier configured an "
+    "eviction spills the block instead of dropping it).  Admission "
+    "headroom = kv_pool_blocks_free + this")
+# Tiered-KV series (ISSUE 14): the HBM→host spill economy.  spills
+# count device evictions whose bytes landed host-side, fetches count
+# blocks restored device-side by an admission (one batched H2D per
+# admission), hits count admissions that restored >= 1 tier block —
+# fetch TTFT vs full re-prefill TTFT is the tier's headline.
+_TIER_SPILLS = telemetry.counter(
+    "kv_tier_spills_total",
+    "evicted device prefix-cache blocks spilled to the host-RAM tier "
+    "(bytes preserved; the next same-prefix admission pays one H2D "
+    "copy instead of a re-prefill)")
+_TIER_FETCHES = telemetry.counter(
+    "kv_tier_fetches_total",
+    "KV blocks restored from the host tier into device pool blocks "
+    "by an admission (batched: one H2D per admission regardless of "
+    "block count)")
+_TIER_HITS = telemetry.counter(
+    "kv_tier_hits_total",
+    "admissions whose chain-hash walk missed the device prefix map "
+    "but restored >= 1 spilled block from the host tier")
+# Disaggregated-serving handoff series (ISSUE 14): a prefill replica's
+# finished prefix blocks shipped into a decode replica through the
+# block-table abstraction (export_prefix -> import_blocks).
+_HANDOFF_BLOCKS = telemetry.counter(
+    "kv_handoff_blocks_total",
+    "prefix KV blocks imported from another replica's export "
+    "(disaggregated prefill->decode handoff)")
+_HANDOFF_BYTES = telemetry.counter(
+    "kv_handoff_bytes_total",
+    "raw K/V bytes imported through prefix handoffs")
 _PREFIX_HITS = telemetry.counter(
     "prefix_cache_hits_total",
     "admissions that mapped >= 1 cached prefix block (prefill ran "
@@ -323,15 +380,24 @@ def _pow2_floor(n: int) -> int:
 # One admission's block plan (host-side, built under _lock):
 # ``phys`` — the slot's physical block ids in table order (cached
 # prefix hits first, then fresh); ``matched`` — how many leading
-# entries are copy-free prefix-cache hits; ``hashes`` — the prompt's
-# full-block chain hashes (for registering the new blocks after the
-# prefill COMMITS); ``n_fresh`` — blocks claimed off the free list;
-# ``dphys`` — the DRAFT model's physical blocks (speculative decode:
-# always fresh, never prefix-shared — same pool, same free list, so
-# draft KV competes in the same admission economy).
+# entries the admit program GATHERS as the cached key prefix
+# (copy-free device hits PLUS host-tier restores); ``hashes`` — the
+# prompt's full-block chain hashes (for registering the new blocks
+# after the prefill COMMITS); ``n_fresh`` — blocks claimed off the
+# free list; ``dphys`` — the DRAFT model's physical blocks
+# (speculative decode: always fresh, never prefix-shared — same pool,
+# same free list, so draft KV competes in the same admission
+# economy); ``reg_from`` — the first hash index NOT already in the
+# device prefix map (registration after commit covers tier-restored
+# blocks and fresh full prompt blocks alike); ``fills`` — the
+# host-tier entries to restore, ``(k, v)`` numpy pairs aligned with
+# hash indices ``[reg_from, reg_from + len(fills))`` — their target
+# pool blocks are the first ``len(fills)`` fresh claims, so ``phys``
+# stays in table order.
 _AdmitPlan = namedtuple("_AdmitPlan", ("phys", "matched", "hashes",
-                                       "n_fresh", "dphys"),
-                        defaults=((),))
+                                       "n_fresh", "dphys", "reg_from",
+                                       "fills"),
+                        defaults=((), 0, ()))
 
 
 def _kill_slots(state, mask):
@@ -351,15 +417,20 @@ class _Pending:
     __slots__ = ("prompt", "n_new", "eos_id", "seed", "temperature",
                  "top_k", "top_p", "t_submit", "deadline", "cancelled",
                  "t0", "emitted", "ttft", "trace_id", "spans",
-                 "_t_decode", "_result", "_error", "_event")
+                 "prefill_only", "_t_decode", "_result", "_error",
+                 "_event")
 
     def __init__(self, prompt, n_new, eos_id, seed,
                  temperature: float = 0.0, top_k: int = 1,
                  top_p: float = 1.0,
                  deadline: Optional[float] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 prefill_only: bool = False):
         self.trace_id = trace_id      # fleet-minted; None standalone
         self.spans = {}               # phase -> open telemetry.Span
+        self.prefill_only = bool(prefill_only)  # disagg: admit +
+                                      # prefill + cache-register, then
+                                      # retire without a decode tick
         self._t_decode = None
         self.prompt = prompt
         self.n_new = n_new
@@ -453,6 +524,16 @@ class GenerationServer:
     and prefills only the uncached suffix; retired prefix blocks stay
     resident (LRU-evicted on demand).
 
+    ``host_tier_blocks`` > 0 arms the TIERED block cache (ISSUE 14):
+    LRU-evicted prefix blocks SPILL their bytes to a capacity-bounded
+    host-RAM tier instead of dying, and a later admission whose chain
+    walk hits a spilled block restores it with ONE batched H2D copy
+    inside the admission dispatch — the effective prefix cache grows
+    far past the HBM-resident pool, at one block copy per revival
+    instead of a re-prefill.  ``prefill_async`` + ``export_prefix`` /
+    ``import_blocks`` ride the same store for disaggregated
+    prefill/decode handoff (see ``serving.ServingFleet`` roles).
+
     ``speculative`` turns on draft-verified multi-token decode: a
     dict with any of ``k`` (draft proposals per round, default 4),
     ``rounds`` (max rounds fused per dispatch, default 2),
@@ -481,6 +562,7 @@ class GenerationServer:
                  block_size: int = 16,
                  kv_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
+                 host_tier_blocks: int = 0,
                  speculative: Optional[dict] = None,
                  queue_limit: int = 1024,
                  tick_timeout_s: Optional[float] = 30.0,
@@ -526,6 +608,20 @@ class GenerationServer:
                 f"{self.block_size} tokens"
                 + (", draft table included)" if self._spec else ")"))
         self.prefix_cache = bool(prefix_cache)
+        # host-RAM tier under the device pool (ISSUE 14): evicted
+        # prefix blocks spill here instead of dying, and admissions
+        # restore spilled blocks with one batched H2D.  0 disables
+        # spilling; import_blocks() lazily creates a default-sized
+        # tier so handoffs work on an unconfigured server too.
+        self.host_tier_blocks = int(host_tier_blocks or 0)
+        if self.host_tier_blocks < 0:
+            raise ValueError("host_tier_blocks must be >= 0")
+        if self.host_tier_blocks and not self.prefix_cache:
+            raise ValueError("host_tier_blocks needs prefix_cache=True "
+                             "(the tier stores evicted prefix-cache "
+                             "blocks)")
+        self._tier = (HostKVTier(self.host_tier_blocks)
+                      if self.host_tier_blocks else None)
         if (top_k is not None or top_p is not None) and temperature <= 0:
             raise ValueError("top_k/top_p need temperature > 0 "
                              "(greedy ignores the filtered tail)")
@@ -588,6 +684,12 @@ class GenerationServer:
         # the split (the global series aggregates every replica)
         self._n_prefix_hits = 0
         self._n_prefix_misses = 0
+        # per-INSTANCE tier tallies (the process-global kv_tier_*
+        # counters aggregate every replica; a router sizing handoffs
+        # or a bench proving THIS replica fetched needs the split)
+        self._n_tier_spills = 0
+        self._n_tier_fetches = 0
+        self._n_tier_hits = 0
         # per-INSTANCE speculative tallies (same reasoning: the fleet
         # router ranks replicas on THEIR acceptance, not the process's)
         self._n_spec_proposed = 0
@@ -664,6 +766,7 @@ class GenerationServer:
             self._evictable = OrderedDict()   # cached ref-0 blocks, LRU
             self._slot_blocks = {}       # slot -> [pool block ids]
         _POOL_FREE.set(self.kv_blocks)
+        _POOL_EVICTABLE.set(0)
 
     # -- public API ----------------------------------------------------
     def refresh_params(self):
@@ -729,9 +832,21 @@ class GenerationServer:
                 "kv_blocks": self.kv_blocks,
                 "free_blocks": (len(self._blocks_free)
                                 + len(self._evictable)),
+                # the ISSUE 14 split of free_blocks: a draining free
+                # list against a full evictable set means every
+                # admission is about to evict (tiered: spill)
+                "free_list_blocks": len(self._blocks_free),
+                "evictable_blocks": len(self._evictable),
                 "cached_blocks": len(self._block_hash),
                 "prefix_hits": self._n_prefix_hits,
                 "prefix_misses": self._n_prefix_misses,
+                # host-tier view (ISSUE 14): resident spilled blocks +
+                # THIS instance's spill/fetch tallies
+                "host_tier_blocks": (len(self._tier)
+                                     if self._tier is not None else 0),
+                "tier_spills": self._n_tier_spills,
+                "tier_fetches": self._n_tier_fetches,
+                "tier_hits": self._n_tier_hits,
                 # speculative view for the fleet router: spec_k > 0
                 # means an admission here pins ~2x blocks (target +
                 # draft tables), and the acceptance rate is the
@@ -760,11 +875,111 @@ class GenerationServer:
         hashes = self._chain_hashes(prompt)   # pure — outside the lock
         n = 0
         with self._lock:
+            tier = self._tier
             for hsh, tok in hashes:
                 entry = self._prefix_map.get(hsh)
                 if entry is None or entry[1] != tok:
                     break
                 n += 1
+            if tier is not None:
+                # host-tier warmth continues the chain: a spilled
+                # block still saves its prefill (one H2D instead),
+                # so affinity should still prefer this replica.
+                # peek() — a probe must not touch the tier's LRU.
+                for j in range(n, len(hashes)):
+                    hsh, tok = hashes[j]
+                    if tier.peek(hsh, tok) is None:
+                        break
+                    n += 1
+        return n
+
+    # -- disagg handoff + host tier (ISSUE 14) -------------------------
+    def _ensure_tier(self) -> HostKVTier:
+        """The host tier, created on demand for handoff imports on a
+        server constructed without ``host_tier_blocks``.  Default
+        capacity: FOUR device pools' worth — a tier sized exactly
+        like the pool would let two concurrent handoffs LRU-evict
+        each other's chain-head entries before either admission runs
+        (the walk then misses at block 0 and the whole handoff is
+        void; ``kv_tier_evictions_total`` is the signal when even 4x
+        thrashes)."""
+        with self._lock:
+            if self._tier is None:
+                self._tier = HostKVTier(max(4 * self.kv_blocks, 1))
+            return self._tier
+
+    def export_prefix(self, prompt_ids, max_wait_s: float = 1.0):
+        """Serialize the prompt's leading cached full blocks for a
+        cross-replica handoff: a list of ``(chain_hash, token_bytes,
+        k, v)`` entries (host numpy K/V bytes per block) readable by
+        :meth:`import_blocks` on any replica of the SAME model.
+        Device-resident entries are read D2H; already-spilled entries
+        come straight from the host tier.  Returns as many LEADING
+        blocks as are resident right now (possibly none) — the
+        importer's admission degrades gracefully: whatever was not
+        handed off just prefills.
+
+        Thread-safe against the scheduler: the D2H read can race a
+        donating dispatch on accelerator backends, so it retries
+        (bounded by ``max_wait_s``) until a committed pool snapshot
+        reads clean."""
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            return []
+        hashes = self._chain_hashes(prompt)   # pure — outside the lock
+        deadline = time.monotonic() + float(max_wait_s)
+        while True:
+            payload, clean = [], True
+            with self._lock:
+                kc, vc, tier = self._kc, self._vc, self._tier
+                for hsh, tok in hashes:
+                    entry = self._prefix_map.get(hsh)
+                    if entry is not None and entry[1] == tok:
+                        blk = entry[0]
+                        try:
+                            k = np.asarray(kc[:, blk])
+                            v = np.asarray(vc[:, blk])
+                        except (RuntimeError, ValueError):
+                            # donated mid-read (jax raises ValueError
+                            # for deleted/donated buffers on some
+                            # backends): retry against the next commit
+                            clean = False
+                            break
+                        payload.append((hsh, tok, k, v))
+                        continue
+                    spilled = (tier.peek(hsh, tok)
+                               if tier is not None else None)
+                    if spilled is None:
+                        break               # chain ends here
+                    payload.append((hsh, tok) + spilled)
+            if clean or time.monotonic() >= deadline:
+                return payload
+            time.sleep(0.002)        # let the in-flight tick commit
+
+    def import_blocks(self, payload) -> int:
+        """Land an :meth:`export_prefix` payload in THIS replica's
+        host tier (creating a default-capacity tier on first use):
+        the next admission whose prompt chain-hashes onto the entries
+        restores them into pool blocks with ONE batched H2D and
+        registers them as device-resident prefix-cache entries —
+        every later same-prefix admission then maps them copy-free.
+        Entries whose chain hash is already device-resident (verified)
+        are skipped.  Returns how many blocks landed."""
+        n = n_bytes = 0
+        tier = None
+        for hsh, tok, k, v in payload:
+            with self._lock:
+                entry = self._prefix_map.get(hsh)
+                if entry is not None and entry[1] == tok:
+                    continue         # already device-resident here
+            if tier is None:
+                tier = self._ensure_tier()
+            tier.put(hsh, tok, k, v)
+            n += 1
+            n_bytes += np.asarray(k).nbytes + np.asarray(v).nbytes
+        if n:
+            _HANDOFF_BLOCKS.inc(n)
+            _HANDOFF_BYTES.inc(n_bytes)
         return n
 
     def drain(self) -> None:
@@ -837,13 +1052,52 @@ class GenerationServer:
             hashes.append((h, tok))
         return hashes
 
+    def _evict_lru_locked(self) -> None:
+        """Evict the LRU refcount-0 cache block back to the free list
+        — SPILLING its bytes to the host tier first when one is
+        configured (ISSUE 14: an evicted prefix block used to die,
+        capping the effective prefix cache at pool size; now the next
+        same-prefix admission pays one H2D copy instead of a full
+        re-prefill).  The D2H read happens under the server lock on
+        the scheduler thread, where the committed pool is never
+        donated-in-flight (the same invariant every admission snapshot
+        relies on)."""
+        blk, _ = self._evictable.popitem(last=False)        # LRU out
+        hsh = self._block_hash.pop(blk)
+        _, tok = self._prefix_map.pop(hsh)
+        # spilling is the CONFIGURED knob (host_tier_blocks > 0), not
+        # tier existence: a lazily-created handoff tier on an
+        # unconfigured server must not start charging a D2H copy per
+        # eviction the operator turned off (imported entries persist
+        # in that tier regardless — fetch never removes them)
+        if self._tier is not None and self.host_tier_blocks:
+            try:
+                k = np.asarray(self._kc[:, blk])
+                v = np.asarray(self._vc[:, blk])
+            except (RuntimeError, ValueError):
+                k = None                 # consumed donated buffer
+                                         # (recovery in flight): the
+            if k is not None:            # block just dies, pre-tier
+                self._tier.put(hsh, tok, k, v)
+                self._n_tier_spills += 1
+                _TIER_SPILLS.inc()
+        self._blocks_free.append(blk)
+
     def _plan_admission_locked(self, req: _Pending):
         """Match cached prefix blocks and claim the rest off the free
         list (evicting LRU cache entries as needed); returns an
         ``_AdmitPlan``, or None when the pool cannot cover the request
         right now — BLOCKS are the scarce resource, so the caller
         leaves the request at the head of the wait line (a retiring
-        request frees blocks, not just a slot)."""
+        request frees blocks, not just a slot).
+
+        The chain walk is TWO-tier: device prefix map first, then the
+        host tier continues the chain past the device segment — each
+        tier hit claims a fresh pool block the admit program restores
+        with one batched H2D (the whole point of spilling).  A
+        mid-chain miss ends the walk in either tier: the chain hash at
+        j certifies the whole prefix through j, so a gap can never be
+        bridged."""
         bs = self.block_size
         total = -(-(req.t0 + req.n_new) // bs)
         hashes = (self._chain_hashes(req.prompt)
@@ -854,12 +1108,32 @@ class GenerationServer:
             if entry is None or entry[1] != tok:
                 break                # miss — or a hash collision,
             matched_ids.append(entry[0])   # which must NOT map in
+        dev_matched = len(matched_ids)
+        # host-tier walk: continue the chain where the device map
+        # stopped (peek() verifies raw token bytes — a collision
+        # degrades to a miss — WITHOUT touching the tier's LRU: a
+        # blocked request is re-planned every scheduler pass, and a
+        # plan that never commits must not pin its entries MRU at
+        # other prompts' expense; the admit COMMIT touches them)
+        fills = []
+        if self._tier is not None:
+            for j in range(dev_matched, len(hashes)):
+                hsh, tok = hashes[j]
+                entry = self._tier.peek(hsh, tok)
+                if entry is None:
+                    break
+                fills.append(entry)
         # speculative decode: the DRAFT's KV table needs the same
         # block count, always fresh (draft rows are proposal-history-
         # dependent, never prefix-shareable) — claimed from the SAME
-        # free list, so draft KV competes in the same economy
-        dneed = total if self._spec is not None else 0
-        need = total - len(matched_ids) + dneed
+        # free list, so draft KV competes in the same economy.  A
+        # prefill-ONLY request never decodes, so it claims no draft
+        # table and skips the draft prefill entirely (a speculative
+        # prefill replica would otherwise pin ~2x blocks per staged
+        # request for KV that is discarded at retire)
+        dneed = (total if self._spec is not None
+                 and not req.prefill_only else 0)
+        need = total - dev_matched + dneed
         # matched hits sitting in the evictable LRU are about to be
         # CLAIMED, not evicted — they don't count as reclaimable
         ev_matched = sum(1 for blk in matched_ids
@@ -875,24 +1149,30 @@ class GenerationServer:
                 self._evictable.pop(blk, None)
             self._block_ref[blk] += 1
         while need > len(self._blocks_free):
-            blk, _ = self._evictable.popitem(last=False)    # LRU out
-            del self._prefix_map[self._block_hash.pop(blk)]
-            self._blocks_free.append(blk)
+            self._evict_lru_locked()
         fresh = [self._blocks_free.pop() for _ in range(need)]
         for blk in fresh:
             self._block_ref[blk] = 1
         dphys = fresh[need - dneed:] if dneed else []
         fresh = fresh[:need - dneed]
-        return _AdmitPlan(matched_ids + fresh, len(matched_ids),
-                          hashes, len(fresh) + len(dphys), dphys)
+        # table order: device hits, then the tier-restore targets (the
+        # FIRST len(fills) fresh claims — aligned with hash indices
+        # [dev_matched, dev_matched + len(fills))), then the suffix's
+        # fresh blocks
+        return _AdmitPlan(matched_ids + fresh,
+                          dev_matched + len(fills), hashes,
+                          len(fresh) + len(dphys), dphys,
+                          reg_from=dev_matched, fills=tuple(fills))
 
     def _register_prefix_locked(self, plan: _AdmitPlan):
         """After the prefill COMMITS, publish the request's new full
-        prompt blocks into the prefix cache (the matched prefix is
-        already there).  Full prompt blocks are never written after
-        prefill — decode writes land at pos >= t0, strictly past every
-        full block — so sharing them is safe by construction."""
-        for j in range(plan.matched, len(plan.hashes)):
+        prompt blocks into the prefix cache — tier-restored blocks
+        (now device-resident with verified bytes) and fresh full
+        prompt blocks alike; the device-matched prefix is already
+        there.  Full prompt blocks are never written after prefill —
+        decode writes land at pos >= t0, strictly past every full
+        block — so sharing them is safe by construction."""
+        for j in range(plan.reg_from, len(plan.hashes)):
             hsh, tok = plan.hashes[j]
             if hsh in self._prefix_map:
                 continue                 # coincident entry stands
@@ -921,7 +1201,15 @@ class GenerationServer:
 
     def _update_free_gauge(self):
         with self._lock:
-            _POOL_FREE.set(len(self._blocks_free) + len(self._evictable))
+            n_free = len(self._blocks_free)
+            n_ev = len(self._evictable)
+        # split gauges (ISSUE 14): free list vs evictable cache —
+        # their SUM is still the admission headroom, but a draining
+        # free list with a full evictable set means every admission
+        # is about to evict (and, tiered, spill) — pressure the old
+        # summed gauge hid
+        _POOL_FREE.set(n_free)
+        _POOL_EVICTABLE.set(n_ev)
 
     def submit_async(self, prompt_ids, n_new: int,
                      eos_id: Optional[int] = None,
@@ -969,11 +1257,59 @@ class GenerationServer:
                        -1 if eos_id is None else int(eos_id), seed,
                        temperature=temp, top_k=tk_eff, top_p=tp_eff,
                        deadline=deadline, trace_id=trace_id)
+        return self._enqueue(req)
+
+    def prefill_async(self, prompt_ids,
+                      deadline_s: Optional[float] = None,
+                      trace_id: Optional[str] = None) -> _Pending:
+        """Enqueue a PREFILL-ONLY request (disaggregated serving,
+        ISSUE 14): the prompt admits into a slot, prefills through the
+        normal chunked/prefix-cached machinery, registers its full
+        prompt blocks in the prefix cache — and retires immediately
+        WITHOUT a decode tick, releasing the slot and parking the
+        blocks as evictable cache entries.  ``result()`` resolves to
+        the prompt itself (nothing is generated).
+
+        The prefill replica's half of the disagg handoff:
+        ``prefill_async(p).result()`` → :meth:`export_prefix` →
+        the decode replica's :meth:`import_blocks` — whose admission
+        of the same prompt then prefills only the last partial
+        block."""
+        if not self.prefix_cache:
+            raise ValueError("prefill_async needs prefix_cache=True "
+                             "(a prefill-only request's sole product "
+                             "is its cached prefix blocks)")
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("GenerationServer has been shut down")
+            if self._admission_closed:
+                raise RuntimeError(
+                    "GenerationServer is draining (admission closed; "
+                    "in-flight work continues)")
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError("prompt_ids must be a non-empty 1-D int "
+                             f"array, got shape {prompt.shape}")
+        if len(prompt) > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) exceeds the slot cache "
+                f"length ({self.max_len})")
+        deadline_s = (self.request_deadline_s if deadline_s is None
+                      else float(deadline_s))
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        req = _Pending(prompt, 0, -1, 0, deadline=deadline,
+                       trace_id=trace_id, prefill_only=True)
+        return self._enqueue(req)
+
+    def _enqueue(self, req: _Pending) -> _Pending:
+        """Queue-put shared by ``submit_async``/``prefill_async``."""
         # replica-queue span: opened on the CALLER's thread, ended by
         # the scheduler at admission (or by whatever retires a never-
         # admitted request) — the tracked-span API exists exactly for
         # this cross-thread close
-        args = {"trace": trace_id} if trace_id is not None else {}
+        args = ({"trace": req.trace_id}
+                if req.trace_id is not None else {})
         req.spans["queue"] = telemetry.get_tracer().begin(
             "request/replica_queue", **args)
         while True:
@@ -1375,17 +1711,20 @@ class GenerationServer:
                 state["dtable"], dtable_row[None], (slot, 0)),
         }
 
-    def _admit_miss_fn(self, tb: int):
+    def _admit_miss_fn(self, tb: int, use_draft: bool = True):
         """Prefix-MISS admission program for prefill bucket ``tb`` (a
         block-size multiple; cached per bucket): batched causal
         prefill of the padded prompt — the SAME prefill numerics
         offline decode runs, parity depends on it — with the K/V rows
-        scattered into the slot's fresh blocks and its table armed."""
-        key = ("miss", tb)
+        scattered into the slot's fresh blocks and its table armed.
+        ``use_draft=False`` traces the draft-free variant a
+        speculative server uses for prefill-ONLY admissions (no draft
+        table is claimed, so there is nothing to prefill)."""
+        key = ("miss", tb, bool(use_draft))
         if key in self._admit_cache:
             return self._admit_cache[key]
         gen = self._gen
-        spec = self._spec
+        spec = self._spec if use_draft else None
 
         def admit(emb_p, blk_stack, head_p, kc, vc, state, prompt, t0,
                   slot, n_new, eos_id, key, temp, tk, tp, phys,
@@ -1418,31 +1757,51 @@ class GenerationServer:
                                               donate_argnums=(3, 4, 5))
         return fn
 
-    def _admit_hit_fn(self, sb: int, matched: int, dtb: int = 0):
+    def _admit_hit_fn(self, sb: int, matched: int, dtb: int = 0,
+                      nfill: int = 0, use_draft: bool = True):
         """Prefix-HIT admission program (cached per (suffix bucket,
-        matched blocks)): gather the ``matched`` cached blocks as the
-        key prefix, chunked-prefill ONLY the suffix, scatter the
-        suffix K/V into the slot's fresh blocks.  The prefix gather is
-        EXACT-length — padding inside the key axis would regroup XLA's
-        softmax/matmul reductions and break byte parity with the
-        full-prompt prefill, so ``matched`` is a compile-key dimension
-        (bounded by max_blocks) instead of a padded pow2.
+        matched blocks, draft bucket, tier fills)): gather the
+        ``matched`` cached blocks as the key prefix, chunked-prefill
+        ONLY the suffix, scatter the suffix K/V into the slot's fresh
+        blocks.  The prefix gather is EXACT-length — padding inside
+        the key axis would regroup XLA's softmax/matmul reductions and
+        break byte parity with the full-prompt prefill, so ``matched``
+        is a compile-key dimension (bounded by max_blocks) instead of
+        a padded pow2.
+
+        ``nfill`` > 0 restores that many host-tier blocks FIRST: the
+        spilled bytes ride in as ONE stacked operand pair (the single
+        batched H2D the tier exists for) and scatter into their
+        claimed pool blocks before the gather reads them — so a
+        tier-restored prefix is bit-identical to a device-resident
+        one, and byte parity holds through the spill→fetch round
+        trip.
 
         With speculation on, the DRAFT still prefills the FULL prompt
         (its blocks are never prefix-shared, so there is nothing
         cached to skip) at its own pow2 bucket ``dtb`` — the hit
         path's prefill saving applies to the target's n layers, the
         draft re-pays its d cheap ones."""
-        key = ("hit", sb, matched, dtb)
+        key = ("hit", sb, matched, dtb, nfill, bool(use_draft))
         if key in self._admit_cache:
             return self._admit_cache[key]
         gen = self._gen
-        spec = self._spec
+        spec = self._spec if use_draft else None
 
         def admit(emb_p, blk_stack, head_p, kc, vc, state, suffix, p0,
                   last_ix, t0, slot, n_new, eos_id, key, temp, tk, tp,
                   prefix_phys, phys, table_row, dtable_row,
-                  *draft_ops):
+                  *extra_ops):
+            if nfill:
+                # host-tier restore: land the spilled bytes in their
+                # claimed pool blocks BEFORE the prefix gather below
+                # reads them (one fused scatter per cache side)
+                fill_ids, fill_k, fill_v = extra_ops[:3]
+                draft_ops = extra_ops[3:]
+                kc = kc.at[:, fill_ids].set(fill_k)
+                vc = vc.at[:, fill_ids].set(fill_v)
+            else:
+                draft_ops = extra_ops
             nl = kc.shape[0]
             h, bs, dh = kc.shape[2], kc.shape[3], kc.shape[4]
             gather = lambda pool: jnp.take(pool, prefix_phys, axis=1) \
@@ -1480,6 +1839,9 @@ class GenerationServer:
         bs = self.block_size
         matched = plan.matched
         p0 = matched * bs
+        # prefill-only admissions skip the draft entirely (no dtable
+        # blocks were claimed — plan.dphys is empty)
+        use_draft = self._spec is not None and not req.prefill_only
         table_row = np.zeros((self.max_blocks,), np.int32)
         table_row[:len(plan.phys)] = plan.phys
         dtable_row = np.zeros((self.max_blocks,), np.int32)
@@ -1525,9 +1887,25 @@ class GenerationServer:
                 scatter_phys = np.zeros((n_sc,), np.int32)
                 scatter_phys[:len(fresh)] = fresh
                 dtb = (-(-_bucket(req.t0, self.max_len) // bs) * bs
-                       if self._spec is not None else 0)
-                extra = draft_ops(dtb) if self._spec is not None else ()
-                out = self._admit_hit_fn(sb, matched, dtb)(
+                       if use_draft else 0)
+                extra = draft_ops(dtb) if use_draft else ()
+                nfill = len(plan.fills)
+                if nfill:
+                    # host-tier restore operands: ONE stacked H2D per
+                    # cache side for the whole admission, however many
+                    # spilled blocks it restores
+                    fill_ids = np.asarray(
+                        plan.phys[plan.reg_from:plan.reg_from + nfill],
+                        np.int32)
+                    fill_ops = (jnp.asarray(fill_ids),
+                                jnp.asarray(np.stack(
+                                    [f[0] for f in plan.fills], axis=1)),
+                                jnp.asarray(np.stack(
+                                    [f[1] for f in plan.fills], axis=1)))
+                else:
+                    fill_ops = ()
+                out = self._admit_hit_fn(sb, matched, dtb, nfill,
+                                         use_draft)(
                     emb_p, blk_stack, head_p, kc, vc, state,
                     jnp.asarray(padded), np.int32(p0),
                     np.int32(req.t0 - p0 - 1), np.int32(req.t0),
@@ -1537,7 +1915,7 @@ class GenerationServer:
                     np.float32(req.top_p),
                     jnp.asarray(plan.phys[:matched], jnp.int32),
                     jnp.asarray(scatter_phys), jnp.asarray(table_row),
-                    jnp.asarray(dtable_row), *extra)
+                    jnp.asarray(dtable_row), *fill_ops, *extra)
             else:
                 tb = -(-_bucket(req.t0, self.max_len) // bs) * bs
                 padded = np.zeros((1, tb), np.int32)
@@ -1546,7 +1924,7 @@ class GenerationServer:
                 scatter_phys = np.zeros((n_sc,), np.int32)
                 head = plan.phys[:n_sc]
                 scatter_phys[:len(head)] = head
-                if self._spec is not None:
+                if use_draft:
                     demb_p, dblk, dhead_p, dpad, dscatter = \
                         draft_ops(tb)
                     # miss path: draft shares the target's padded
@@ -1554,7 +1932,7 @@ class GenerationServer:
                     extra = (demb_p, dblk, dhead_p, dscatter)
                 else:
                     extra = ()
-                out = self._admit_miss_fn(tb)(
+                out = self._admit_miss_fn(tb, use_draft)(
                     emb_p, blk_stack, head_p, kc, vc, state,
                     jnp.asarray(padded), np.int32(req.t0),
                     np.int32(slot), np.int32(req.n_new),
@@ -1579,10 +1957,25 @@ class GenerationServer:
                 self._n_prefix_hits += 1
             else:
                 self._n_prefix_misses += 1
+            n_fills = len(plan.fills)
+            if n_fills:
+                self._n_tier_fetches += n_fills
+                self._n_tier_hits += 1
+                if self._tier is not None:
+                    # LRU touch at COMMIT, not plan time (peek above)
+                    for j in range(plan.reg_from,
+                                   plan.reg_from + n_fills):
+                        self._tier.touch(plan.hashes[j][0])
         _ADMITTED.inc()
         if matched:
             _PREFIX_HITS.inc()
-            _KV_BLK_SHARED.inc(matched)
+            # device-map hits are COPY-FREE shares; tier restores are
+            # counted as fetches, not shares
+            if matched > n_fills:
+                _KV_BLK_SHARED.inc(matched - n_fills)
+            if n_fills:
+                _TIER_FETCHES.inc(n_fills)
+                _TIER_HITS.inc()
         else:
             _PREFIX_MISSES.inc()
         if plan.n_fresh:
@@ -1598,7 +1991,10 @@ class GenerationServer:
                 req._result = self._ids[slot,
                                         :req.t0 + req.emitted].copy()
             dt = time.perf_counter() - req.t_submit
-            if dt > 0:
+            # prefill-only retires emit nothing by design — a 0.0
+            # sample per staged request would drag the fleet-wide
+            # tokens/s percentiles toward 0 on dashboards
+            if dt > 0 and not req.prefill_only:
                 _RATE.observe(req.emitted / dt)
         # close every phase span the request still holds, on WHATEVER
         # thread retires it (scheduler, watchdog recovery, shutdown) —
@@ -1995,9 +2391,31 @@ class GenerationServer:
                         sp_p = req.spans.pop("prefill", None)
                         if sp_p is not None:
                             sp_p.end()
-                        req._t_decode = time.perf_counter()
+                        t_done = time.perf_counter()
                         _PHASE.labels(phase="prefill").observe(
-                            req._t_decode - t_adm)
+                            t_done - t_adm)
+                        if req.prefill_only:
+                            # disagg prefill-only: the cached prefix
+                            # blocks ARE the product — release the
+                            # slot now (blocks park evictable for
+                            # export/the next same-prefix admission)
+                            # instead of letting a 0-budget slot ride
+                            # a decode tick
+                            with self._lock:
+                                if self._epoch != my_epoch:
+                                    return
+                                del self._active[slot]
+                                self._free.append(slot)
+                                n_drained = \
+                                    self._release_slot_blocks_locked(
+                                        slot)
+                                n_active = len(self._active)
+                            if n_drained:
+                                _KV_BLK_FREED.inc(n_drained)
+                            self._update_free_gauge()
+                            self._retire(req, slot)
+                            continue
+                        req._t_decode = t_done
                         req.spans["decode"] = tracer.begin(
                             "request/decode", slot=slot, **targs)
                     if not committed:

@@ -15,6 +15,13 @@ TPU-fleet retrospective says must be designed in:
 * **prefix-affinity placement** (:mod:`~.placement`): same-prefix
   requests route to the replica whose prefix cache is warm,
   least-loaded-by-free-blocks otherwise;
+* **disaggregated prefill/decode** (ISSUE 14): per-replica ``roles``
+  split the fleet — long-prompt requests stage through a prefill
+  replica, whose finished prefix blocks hand off to a decode replica
+  through the paged-KV block abstraction (``export_prefix`` →
+  ``import_blocks``), so a compute-bound chunked prefill never stalls
+  the memory-bound decode ticks; byte parity with a unified decode
+  holds end to end;
 * **lifecycle**: health-weighted dispatch, ``drain()`` for rolling
   restarts, and live migration — a dead or hard-drained replica's
   queued and in-flight requests re-place onto survivors and complete
@@ -46,7 +53,10 @@ from deeplearning4j_tpu.serving.errors import (DeadlineInfeasibleError,
                                                NoHealthyReplicaError,
                                                QuotaExceededError)
 from deeplearning4j_tpu.serving.placement import (AFFINITY, FAILOVER,
-                                                  LEAST_LOADED,
+                                                  HANDOFF, LEAST_LOADED,
+                                                  PREFILL, ROLE_DECODE,
+                                                  ROLE_PREFILL,
+                                                  ROLE_UNIFIED, ROLES,
                                                   choose_replica,
                                                   replica_view)
 from deeplearning4j_tpu.serving.router import ServingFleet
@@ -60,5 +70,6 @@ __all__ = [
     "FleetAdmissionError", "QuotaExceededError",
     "DeadlineInfeasibleError", "NoHealthyReplicaError",
     "choose_replica", "replica_view",
-    "AFFINITY", "LEAST_LOADED", "FAILOVER",
+    "AFFINITY", "LEAST_LOADED", "FAILOVER", "PREFILL", "HANDOFF",
+    "ROLES", "ROLE_PREFILL", "ROLE_DECODE", "ROLE_UNIFIED",
 ]

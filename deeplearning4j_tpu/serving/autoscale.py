@@ -466,7 +466,14 @@ class Autoscaler:
                                 for r in fstats["replicas"]
                                 if not r["dead"] and not r["removed"])
                             if fstats else 0.0)
+        # admission headroom = free list + evictable cache (ISSUE 14
+        # split the summed gauge in two; the floor signal still wants
+        # the sum — an evictable block is reclaimable-by-spill, not
+        # pressure by itself)
         free_blocks = self._gauge_sum(reg, "kv_pool_blocks_free")
+        ev_blocks = self._gauge_sum(reg, "kv_pool_blocks_evictable")
+        if free_blocks is not None and ev_blocks is not None:
+            free_blocks += ev_blocks
         healthy = self._gauge_sum(reg, "fleet_replicas_healthy") or 0.0
 
         up_reasons = []
@@ -621,21 +628,44 @@ class Autoscaler:
                         self.batch_tenants)
         return action
 
+    @staticmethod
+    def _decode_capable(r: dict) -> bool:
+        return r.get("role", "unified") != "prefill"
+
     def _removable(self, idx: int) -> bool:
-        """Is ``idx`` still a live replica worth scaling in?"""
+        """Is ``idx`` still a live replica worth scaling in?  Never
+        the last live DECODE-CAPABLE replica of a disaggregated fleet
+        — removing it would brick the fleet (remove_replica refuses
+        anyway; don't burn the down action on a refusal)."""
         st = self.fleet.stats()
         if not 0 <= idx < len(st["replicas"]):
             return False
         r = st["replicas"][idx]
-        return not r["dead"] and not r["removed"]
+        if r["dead"] or r["removed"]:
+            return False
+        if self._decode_capable(r):
+            others = [i for i, o in enumerate(st["replicas"])
+                      if i != idx and not o["dead"] and not o["removed"]
+                      and self._decode_capable(o)]
+            if not others:
+                return False
+        return True
 
     def _pick_removable(self) -> Optional[int]:
         """Highest-index live replica when the loop added none itself
-        (still bounded below by min_replicas at the decision site)."""
+        (still bounded below by min_replicas at the decision site);
+        role-aware: the last decode-capable replica is never a
+        candidate."""
         st = self.fleet.stats()
         live = [i for i, r in enumerate(st["replicas"])
                 if not r["dead"] and not r["removed"]]
-        return max(live) if len(live) > 1 else None
+        if len(live) <= 1:
+            return None
+        decode_live = [i for i in live
+                       if self._decode_capable(st["replicas"][i])]
+        cands = [i for i in live
+                 if i not in decode_live or len(decode_live) > 1]
+        return max(cands) if cands else None
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):

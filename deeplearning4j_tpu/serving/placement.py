@@ -36,6 +36,20 @@ from deeplearning4j_tpu.parallel.generation_server import GenerationServer
 AFFINITY = "affinity"
 LEAST_LOADED = "least_loaded"
 FAILOVER = "failover"
+#: disaggregated serving (ISSUE 14): a long-prompt request's prefill
+#: stage landing on a prefill-role replica, and its decode stage
+#: landing on a decode replica carrying the exported prefix blocks
+PREFILL = "prefill"
+HANDOFF = "handoff"
+
+#: per-replica roles (``ServingFleet(roles=...)``): a ``prefill``
+#: replica only takes prefill stages of long-prompt requests, a
+#: ``decode`` replica only decode traffic, ``unified`` (the default)
+#: takes everything — existing fleets are untouched
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_UNIFIED = "unified"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_UNIFIED)
 
 
 def replica_view(idx: int, server: GenerationServer,

@@ -47,7 +47,28 @@ the slot scheduler inside each ``GenerationServer``:
   dispatch candidate only after its first successful ``stats()``) and
   :meth:`ServingFleet.remove_replica` scales in through the same
   drain→migrate machinery — the serving mirror of the training
-  layer's N→M elastic resume.
+  layer's N→M elastic resume;
+* **disaggregated prefill/decode** (ISSUE 14) — ``roles`` assigns
+  each replica ``"prefill"``/``"decode"``/``"unified"`` (default
+  unified: existing fleets untouched).  Chunked prefill is
+  compute-bound and decode memory-bound, and in a unified replica one
+  long admission stalls every decoding stream behind its prefill.
+  With roles split, the router classifies at admission (it already
+  costs prompt+budget tokens): prompts >= ``prefill_threshold``
+  tokens stage through a prefill replica
+  (``GenerationServer.prefill_async`` — admit + chunked prefill +
+  prefix-cache registration, no decode ticks), then the finished
+  prefix hands off to a decode replica as a BLOCK TRANSFER through
+  PR 7's table abstraction: ``export_prefix`` serializes the blocks
+  (chain hashes + raw token bytes + K/V bytes), ``import_blocks``
+  lands them on the target, and the decode admission restores them
+  with one batched H2D and registers them device-resident — every
+  later same-prefix admission maps them copy-free.  Greedy byte
+  parity holds end to end (the restored bytes ARE the prefill
+  replica's, and both replicas run identical prefill numerics), and a
+  prefill replica dying mid-handoff re-places the request through the
+  EXISTING migration machinery — reclassified against the surviving
+  topology, completing byte-identical either way.
 
 The fleet is in-process: replicas share the host and its device(s),
 which is the single-chip degenerate of the multi-host layout (each
@@ -93,7 +114,10 @@ from deeplearning4j_tpu.resilience.retry import backoff_delay, retry_call
 from deeplearning4j_tpu.serving.errors import (DeadlineInfeasibleError,
                                                NoHealthyReplicaError,
                                                QuotaExceededError)
-from deeplearning4j_tpu.serving.placement import FAILOVER, choose_replica
+from deeplearning4j_tpu.serving.placement import (FAILOVER, HANDOFF,
+                                                  PREFILL, ROLE_PREFILL,
+                                                  ROLE_UNIFIED, ROLES,
+                                                  choose_replica)
 from deeplearning4j_tpu.serving.tenancy import TenantAccountant, TenantQuota
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -102,10 +126,12 @@ _INF = float("inf")
 
 _REQS = telemetry.counter(
     "fleet_requests_total",
-    "fleet admission outcomes per tenant: admitted (dispatched to a "
-    "replica), queued (waited >= 1 pass on quota/capacity), "
-    "rejected_quota, rejected_deadline (infeasible SLO), migrated "
-    "(re-placed off a dead/drained replica), cancelled, expired, "
+    "fleet admission outcomes per tenant: admitted (first dispatch "
+    "to a replica — a disagg request's prefill placement), queued "
+    "(waited >= 1 pass on quota/capacity), rejected_quota, "
+    "rejected_deadline (infeasible SLO), migrated (re-placed off a "
+    "dead/drained replica), handed_off (a disagg request's decode "
+    "placement carrying its exported prefix), cancelled, expired, "
     "failed", labelnames=("tenant", "outcome"))
 _DISPATCH = telemetry.counter(
     "fleet_replica_dispatch_total",
@@ -165,7 +191,8 @@ class _FleetRequest:
     __slots__ = ("prompt", "n_new", "eos_id", "seed", "sampling",
                  "tenant", "priority", "cost", "deadline", "t_submit",
                  "t_submit_m", "cancelled", "migrations", "replica",
-                 "inner", "ttft", "trace_id", "spans", "_t_dispatch",
+                 "inner", "ttft", "trace_id", "spans", "stage",
+                 "handoff", "prefill_replica", "_t_dispatch",
                  "_not_before", "_migrate", "_quota_held",
                  "_queued_counted", "_migrating", "_result", "_error",
                  "_event")
@@ -189,6 +216,15 @@ class _FleetRequest:
         self.migrations = 0
         self.replica: Optional[int] = None
         self.inner = None             # the replica-side handle
+        # disaggregated serving (ISSUE 14): ``stage`` is the NEXT
+        # placement's kind — None (unclassified), "prefill" (route to
+        # a prefill-role replica) or "decode"; ``handoff`` carries the
+        # exported prefix payload between the stages (kept until the
+        # request finishes, so a decode-replica death re-imports on
+        # the survivor instead of re-prefilling)
+        self.stage: Optional[str] = None
+        self.handoff = None
+        self.prefill_replica: Optional[int] = None
         self.ttft = None              # submit -> first token of the
                                       # SUCCESSFUL attempt (queue wait
                                       # + any migration included)
@@ -259,7 +295,17 @@ class ServingFleet:
     beyond "deadline already spent").  ``migration_retries`` bounds
     how many times one request may re-place off dying replicas before
     its last failure propagates; re-placements back off with the
-    resilience layer's full-jitter ``backoff_delay``.  Remaining
+    resilience layer's full-jitter ``backoff_delay``.
+
+    ``roles`` (ISSUE 14) disaggregates the fleet: one
+    ``"prefill"``/``"decode"``/``"unified"`` entry per replica
+    (default all unified).  Prompts of at least ``prefill_threshold``
+    tokens (default: two full KV blocks + 1) stage through a prefill
+    replica and hand their finished prefix blocks off to a decode
+    replica — byte-identical to a unified decode, with the long
+    prefill off the decode replicas' tick path.  Pass
+    ``host_tier_blocks`` (a server kwarg) to also spill evicted
+    prefix blocks to host RAM on every replica.  Remaining
     ``**server_kwargs`` construct the replicas (``speculative`` —
     draft-verified multi-token decode, whose per-replica acceptance
     rate surfaces through ``stats()`` — plus ``n_slots``,
@@ -274,10 +320,33 @@ class ServingFleet:
                  poll_interval_s: float = 0.002,
                  dead_after_s: float = 1.0,
                  queue_limit: int = 4096,
+                 roles: Optional[Iterable[str]] = None,
+                 prefill_threshold: Optional[int] = None,
                  **server_kwargs):
         self.n_replicas = int(n_replicas)
         if self.n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
+        # per-replica roles (ISSUE 14 disaggregated prefill/decode) —
+        # validated BEFORE any replica is constructed, so a bad config
+        # leaks no scheduler threads
+        if roles is None:
+            role_list = [ROLE_UNIFIED] * self.n_replicas
+        else:
+            role_list = [str(r) for r in roles]
+            if len(role_list) != self.n_replicas:
+                raise ValueError(
+                    f"roles has {len(role_list)} entries for "
+                    f"n_replicas={self.n_replicas}")
+            bad = [r for r in role_list if r not in ROLES]
+            if bad:
+                raise ValueError(f"unknown role(s) {bad}; each role "
+                                 f"must be one of {ROLES}")
+            if (ROLE_PREFILL in role_list
+                    and all(r == ROLE_PREFILL for r in role_list)):
+                raise ValueError(
+                    "a prefill-only fleet cannot decode — at least "
+                    "one replica needs role 'decode' or 'unified'")
+        self._roles: List[str] = role_list
         self.est_token_s = (float(est_token_s)
                             if est_token_s is not None else None)
         self.migration_retries = int(migration_retries)
@@ -290,6 +359,13 @@ class ServingFleet:
         self._server_kwargs = dict(server_kwargs)
         self._servers = [GenerationServer(net, **server_kwargs)
                          for _ in range(self.n_replicas)]
+        # disagg classification bar: prompts at least this long (>= 2
+        # full KV blocks by default) route through a prefill replica
+        # when one is live; shorter prompts always go direct — their
+        # prefill is too cheap to be worth a handoff round trip
+        self.prefill_threshold = (
+            int(prefill_threshold) if prefill_threshold is not None
+            else 2 * self._servers[0].block_size + 1)
         self._acct = TenantAccountant(default_quota, quotas)
         # fleet scheduler state: everything below mutates ONLY under
         # _lock (the GenerationServer discipline, one level up)
@@ -467,15 +543,20 @@ class ServingFleet:
             self._servers[idx].shutdown(drain=False, timeout=timeout)
         self._wake()
 
-    def add_replica(self) -> int:
+    def add_replica(self, role: str = ROLE_UNIFIED) -> int:
         """LIVE SCALE-OUT: construct one more replica from the fleet's
         founding ``net`` + server config and join it; returns its
-        index.  The newcomer enters the dispatch candidate set — and
-        the prefix-affinity probe — only after its FIRST successful
-        ``stats()`` (observed by the scheduler's health sweep): a
-        replica still constructing must not catch traffic it cannot
-        report on, and ``fleet_replicas_healthy`` only rises when it
-        actually becomes dispatchable."""
+        index.  ``role`` slots it into the disagg topology (default
+        unified).  The newcomer enters the dispatch candidate set —
+        and the prefix-affinity probe — only after its FIRST
+        successful ``stats()`` (observed by the scheduler's health
+        sweep): a replica still constructing must not catch traffic it
+        cannot report on, and ``fleet_replicas_healthy`` only rises
+        when it actually becomes dispatchable."""
+        role = str(role)
+        if role not in ROLES:
+            raise ValueError(f"unknown role {role!r}; must be one of "
+                             f"{ROLES}")
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("ServingFleet has been shut down")
@@ -489,6 +570,7 @@ class ServingFleet:
                 down = False
                 idx = len(self._servers)
                 self._servers.append(srv)
+                self._roles.append(role)
                 self.n_replicas += 1
                 self._joining.add(idx)
         if down:
@@ -511,6 +593,23 @@ class ServingFleet:
         with self._lock:
             if idx in self._removed:
                 return
+            roles = list(self._roles)
+            if roles[idx] != ROLE_PREFILL:
+                # the constructor's >=1-decode-capable invariant must
+                # survive scale-in too: removing the last live decode
+                # replica would brick the fleet (a surviving prefill
+                # replica cannot complete anything) — refuse, like the
+                # role validation at construction
+                others = [i for i in range(len(self._servers))
+                          if i != idx and i not in self._removed
+                          and i not in self._dead
+                          and roles[i] != ROLE_PREFILL]
+                if not others:
+                    raise ValueError(
+                        f"replica {idx} is the last live "
+                        "decode-capable replica — removing it would "
+                        "leave the fleet unable to decode (add a "
+                        "decode/unified replica first)")
             self._removed.add(idx)
             self._joining.discard(idx)
         self.drain(idx, hard=True)
@@ -569,6 +668,7 @@ class ServingFleet:
         count, and the per-tenant accounting view."""
         with self._lock:
             servers = list(self._servers)
+            roles = list(self._roles)
             dead = set(self._dead)
             draining = set(self._draining)
             joining = set(self._joining)
@@ -578,6 +678,7 @@ class ServingFleet:
         replicas = []
         for i, srv in enumerate(servers):
             st = srv.stats()
+            st["role"] = roles[i]
             st["dead"] = i in dead
             st["draining"] = bool(st["draining"]) or i in draining
             st["joining"] = i in joining
@@ -719,6 +820,10 @@ class ServingFleet:
             self._acct.drop_queued(req.tenant)
         if outcome:
             _REQS.labels(tenant=req.tenant, outcome=outcome).inc()
+        # the handle outlives the request (callers hold it for
+        # .ttft/.replica): drop the exported K/V payload now — its
+        # re-import-on-migration purpose ends at terminal state
+        req.handoff = None
         if error is not None:
             req._error = error
         else:
@@ -840,7 +945,17 @@ class ServingFleet:
         Intra-pass dispatches fold back in via ``extra_load`` so
         least-loaded placement still spreads within one pass; only
         the per-request prefix-warmth probe touches a replica per
-        waiting request, and only after its quota cleared."""
+        waiting request, and only after its quota cleared.
+
+        DISAGG classification (ISSUE 14) happens here, where the
+        router already costs the prompt: a request whose prompt is at
+        least ``prefill_threshold`` tokens — and whose prefix is not
+        already warm on a decode-capable replica — stages through a
+        prefill-role replica first (``stage="prefill"``); everything
+        else decodes direct.  Prefill replicas never take decode
+        traffic, decode replicas never take prefill stages, unified
+        replicas take only decode/direct traffic (a unified replica
+        IS its own prefill)."""
         with self._lock:
             if not self._waiting:
                 return 0
@@ -850,27 +965,30 @@ class ServingFleet:
                                          is not None else _INF,
                                          r.t_submit_m))
             n = len(self._servers)
+            roles = list(self._roles)
             # terminal only when nothing can EVER take the work: every
-            # non-removed replica is dead and no newcomer is joining
+            # non-removed DECODE-CAPABLE replica is dead and no
+            # newcomer is joining (a fleet of surviving prefill-only
+            # replicas cannot complete anything either)
             all_dead = (not self._joining
                         and all(i in self._dead or i in self._removed
+                                or roles[i] == ROLE_PREFILL
                                 for i in range(n)))
             cand = [i for i in range(n)
                     if i not in self._dead and i not in self._draining
                     and i not in self._removed
                     and i not in self._joining]
-        base = {}
+        pre_cand = [i for i in cand if roles[i] == ROLE_PREFILL]
+        base, pbase = {}, {}
         for i in cand:
             st = self._servers[i].stats()
             if st["healthy"] and not st["draining"]:
-                base[i] = st
-        extra_load = {i: 0 for i in base}
-        extra_blocks = {i: 0 for i in base}   # blocks claimed this
-                                              # pass (free_blocks is a
-                                              # snapshot — without
-                                              # this, one stale count
-                                              # piles a whole burst
-                                              # onto one replica)
+                (pbase if roles[i] == ROLE_PREFILL else base)[i] = st
+        extra_load = {i: 0 for i in (*base, *pbase)}
+        extra_blocks = {i: 0 for i in (*base, *pbase)}
+        # blocks claimed this pass (free_blocks is a snapshot —
+        # without the compensation, one stale count piles a whole
+        # burst onto one replica)
         n_dispatched = 0
         for req in line:
             if now < req._not_before:
@@ -883,8 +1001,8 @@ class ServingFleet:
                     if req in self._waiting:
                         self._waiting.remove(req)
                 self._finish(req, error=NoHealthyReplicaError(
-                    "every fleet replica is dead — the request "
-                    "was never applied; safe to retry"),
+                    "every decode-capable fleet replica is dead — "
+                    "the request was never applied; safe to retry"),
                     outcome="failed")
                 continue
             if not req._quota_held:
@@ -893,13 +1011,49 @@ class ServingFleet:
                     self._count_queued(req)
                     continue
                 req._quota_held = True
-            if not base:
-                # capacity wait: every replica draining/recovering
+            warmths = None           # classification probes, reused
+                                     # by the views below (one hash
+                                     # walk per replica per request)
+            if req.stage is None:
+                req.stage = "decode"
+                if (pre_cand and req.handoff is None
+                        and len(req.prompt) >= self.prefill_threshold):
+                    # block_size is a static server attribute (all
+                    # replicas share the founding kwargs) — deriving
+                    # it from the healthy-stats snapshot would stamp
+                    # a long prompt "decode" forever during a pass
+                    # where no replica happened to be dispatchable
+                    full = ((len(req.prompt) - 1)
+                            // self._servers[0].block_size)
+                    warmths = {i: self._servers[i].prefix_warmth(
+                        req.prompt) for i in base}
+                    # an already-warm decode replica beats a handoff:
+                    # its admission maps the blocks copy-free, so the
+                    # prefill stage would buy nothing
+                    if full > 0 and max(warmths.values(),
+                                        default=0) < full:
+                        req.stage = "prefill"
+            if req.stage == "prefill" and not pbase:
+                if pre_cand:
+                    # prefill replicas exist but none is dispatchable
+                    # this pass (recovering): wait, don't stall decode
+                    # replicas with a long prefill
+                    self._count_queued(req)
+                    continue
+                req.stage = "decode"     # none left: decode direct
+            if req.stage == "prefill":
+                pool = pbase
+            else:
+                pool = base
+            if not pool:
+                # capacity wait: every candidate draining/recovering
                 self._count_queued(req)
                 continue
+            if warmths is None or pool is not base:
+                warmths = {i: self._servers[i].prefix_warmth(
+                    req.prompt) for i in pool}
             views = [{"idx": i,
-                      "warmth": self._servers[i].prefix_warmth(
-                          req.prompt),
+                      "warmth": warmths[i],
                       "free_blocks": (st["free_blocks"]
                                       - extra_blocks[i]),
                       "load": (st["live_slots"] + st["queue_depth"]
@@ -907,21 +1061,26 @@ class ServingFleet:
                       "spec_k": st.get("spec_k", 0),
                       "spec_acceptance": st.get(
                           "spec_acceptance_rate", 0.0)}
-                     for i, st in base.items()]
+                     for i, st in pool.items()]
             refused = set()
             status, idx = self._place(req, views, refused)
             for i in refused:
                 # a refusing replica (raced drain/shutdown) refuses
                 # everyone: stop re-attempting it this pass
-                base.pop(i, None)
+                pool.pop(i, None)
             if status == "placed":
                 extra_load[idx] += 1
-                bs = base[idx]["block_size"]
-                blocks = -(-(len(req.prompt) + req.n_new) // bs)
-                if base[idx].get("spec_k", 0):
+                bs = pool[idx]["block_size"]
+                n_toks = len(req.prompt) + (
+                    0 if req.stage == "prefill" else req.n_new)
+                blocks = -(-n_toks // bs)
+                if pool[idx].get("spec_k", 0) \
+                        and req.stage != "prefill":
                     # a speculative replica pins the draft's table too
                     # — without the 2x the intra-pass compensation
-                    # under-counts and a burst piles onto the replica
+                    # under-counts and a burst piles onto the replica.
+                    # Prefill-ONLY admissions claim no draft table
+                    # (generation_server skips dneed), so they stay 1x
                     blocks *= 2
                 extra_blocks[idx] += blocks
                 n_dispatched += 1
@@ -939,23 +1098,48 @@ class ServingFleet:
         candidate refused, or ``("failed", None)`` when the request
         terminally failed."""
         views = list(views)
+        prefill_stage = req.stage == "prefill"
         sp_place = telemetry.get_tracer().begin(
             "request/placement", trace=req.trace_id,
-            candidates=len(views))
+            candidates=len(views), stage=req.stage or "decode")
         t_place = time.perf_counter()
         while views:
             idx, reason = choose_replica(views)
             if req._migrating:
                 reason = FAILOVER
+            elif prefill_stage:
+                reason = PREFILL
+            elif req.handoff is not None:
+                reason = HANDOFF
             srv = self._servers[idx]
             remaining = (None if req.deadline is None
                          else max(req.deadline - time.monotonic(),
                                   1e-3))
             try:
-                inner = srv.submit_async(
-                    req.prompt, req.n_new, eos_id=req.eos_id,
-                    seed=req.seed, deadline_s=remaining,
-                    sampling=req.sampling, trace_id=req.trace_id)
+                if prefill_stage:
+                    # disagg stage 1: chunked prefill into the prefill
+                    # replica's pool; the handoff export happens when
+                    # the handle resolves (completion pass)
+                    inner = srv.prefill_async(
+                        req.prompt, deadline_s=remaining,
+                        trace_id=req.trace_id)
+                else:
+                    if req.handoff is not None:
+                        # disagg stage 2: land the exported prefix in
+                        # THIS replica before its admission runs, so
+                        # the chain walk restores it (one batched H2D)
+                        # instead of re-prefilling.  A failed import
+                        # only costs a cold prefill, never the request.
+                        try:
+                            srv.import_blocks(req.handoff)
+                        except Exception:
+                            log.exception(
+                                "handoff import into replica %d "
+                                "failed; decoding cold", idx)
+                    inner = srv.submit_async(
+                        req.prompt, req.n_new, eos_id=req.eos_id,
+                        seed=req.seed, deadline_s=remaining,
+                        sampling=req.sampling, trace_id=req.trace_id)
             except RuntimeError:
                 # raced into a draining/shutdown replica: drop it from
                 # the candidate ranking and try the next one
@@ -1000,9 +1184,15 @@ class ServingFleet:
                 req._migrating = False
                 _REQS.labels(tenant=req.tenant,
                              outcome="migrated").inc()
-            else:
+            elif first:
                 _REQS.labels(tenant=req.tenant,
                              outcome="admitted").inc()
+            else:
+                # the decode stage of a disagg request already counted
+                # admitted at its prefill placement — one request, one
+                # admitted outcome; the handoff gets its own label
+                _REQS.labels(tenant=req.tenant,
+                             outcome="handed_off").inc()
             if req.cancelled:
                 inner.cancel()       # raced a cancel mid-placement
             return "placed", idx
@@ -1036,6 +1226,13 @@ class ServingFleet:
             except BaseException as e:
                 err, result = e, None
             if err is None:
+                if req.stage == "prefill":
+                    # disagg stage 1 finished: export the prefix off
+                    # the prefill replica and requeue for the decode
+                    # stage (NOT a migration — no backoff, and the
+                    # cancelled/expired cases fall to the next reap)
+                    self._hand_off(req)
+                    continue
                 with self._lock:
                     if req in self._inflight:
                         self._inflight.remove(req)
@@ -1051,6 +1248,38 @@ class ServingFleet:
             else:
                 self._remove_and_finish(req, err, "failed")
         return n_done
+
+    def _hand_off(self, req: _FleetRequest) -> None:
+        """Disagg stage transition: the prefill replica finished, so
+        export its registered prefix blocks (raw bytes + chain
+        hashes) and send the request back to the wait line as a
+        decode-stage request carrying the payload.  An export that
+        fails (replica dying under us) degrades to an empty handoff —
+        the decode replica re-prefills, byte-identically."""
+        payload = None
+        try:
+            # short dirty-read budget: this runs ON the fleet
+            # scheduler thread, so a long retry loop would stall
+            # every tenant's dispatch behind one handoff — an export
+            # that can't read a committed pool quickly degrades to an
+            # empty payload (decode re-prefills, byte-identically).
+            # On a prefill-ONLY replica the scheduler idles right
+            # after the retire, so the first read is normally clean.
+            payload = self._servers[req.replica].export_prefix(
+                req.prompt, max_wait_s=0.25)
+        except Exception:
+            log.exception("prefix export off replica %s failed; the "
+                          "decode stage will re-prefill", req.replica)
+        with self._lock:
+            if req in self._inflight:
+                self._inflight.remove(req)
+            req.prefill_replica = req.replica
+            req.inner = None
+            req.replica = None
+            req._migrate = False
+            req.stage = "decode"
+            req.handoff = payload or None
+            self._waiting.append(req)
 
     def _abandon_placement(self, req: _FleetRequest,
                            now: float) -> int:
@@ -1132,6 +1361,10 @@ class ServingFleet:
             req._migrate = False
             req._migrating = True
             req._not_before = now + delay
+            # re-classify on the next pass: the replica set changed
+            # (a killed prefill replica's request may go direct; a
+            # held handoff payload keeps its decode-stage fast path)
+            req.stage = None
             self._waiting.append(req)
 
     def _run(self) -> None:

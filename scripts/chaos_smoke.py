@@ -17,6 +17,10 @@ kind against a real (tiny, CPU-sized) training run and a real
   nothing resubmitted), and a stuck tick with a poisoned slot drops
   ONLY that slot — the two unaffected callers finish offline-identical
   and the implicated one rides a submit retry through;
+* a DISAGGREGATED fleet (prefill + decode roles, ISSUE 14) survives a
+  SIGKILL of its prefill replica mid-handoff: the staged requests
+  re-place through the existing migration machinery onto the decode
+  survivor and complete byte-identical to offline ``generate()``;
 * every recovery event landed in the telemetry registry
   (``faults_injected_total{kind=...}`` for each kind, resume/preempt/
   bad-step/watchdog counters, ``fleet_*`` + ``kv_slots_*`` counters,
@@ -390,6 +394,63 @@ def main() -> int:
     if outcome_total("migrated") - mig0 < 1:
         problems.append("fleet kill produced no migrated requests")
 
+    # -- disaggregated prefill/decode (ISSUE 14): kill the PREFILL
+    # replica with long-prompt requests staged on it mid-handoff —
+    # every request re-places through the EXISTING migration
+    # machinery (reclassified direct against the surviving decode
+    # replica, since no prefill replica remains) and completes
+    # byte-identical to offline generate(); the migrated outcome is
+    # asserted on the real scrape at the bottom.  The kill races the
+    # (fast) prefill stage, so the scenario retries on a fresh fleet
+    # until the kill lands while >= 1 request is still placed on the
+    # prefill replica.
+    base9 = np.arange(1, 10, dtype=np.int32)
+    d_longs = [np.concatenate([base9, np.asarray(
+        [i + 1, i + 2, i + 3, i + 4], np.int32)]) for i in range(3)]
+    d_refs = [offline.generate(p[None], n_new=8)[0] for p in d_longs]
+    migd0 = outcome_total("migrated")
+    for attempt in range(3):
+        with ServingFleet(gpt, n_replicas=2,
+                          roles=("prefill", "decode"), n_slots=2,
+                          max_len=32, block_size=4, tick_batch=1,
+                          tick_timeout_s=None) as dfleet:
+            # one clean round trip first: prefill -> handoff -> decode
+            out_d = dfleet.submit(d_longs[0], n_new=8, timeout=300)
+            if not np.array_equal(out_d, d_refs[0]):
+                problems.append("disagg decode diverged from offline "
+                                "generate() pre-kill")
+            if dfleet.replica(1).stats()["tier_fetches"] < 1:
+                problems.append("disagg handoff restored no blocks on "
+                                "the decode replica")
+            hs_d = [dfleet.submit_async(p, n_new=8)
+                    for p in d_longs[1:]]
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if any(h.replica == 0 for h in hs_d):
+                    break            # staged on the prefill replica
+                if all(h.done() for h in hs_d):
+                    break            # lost the race outright: don't
+                                     # burn the deadline, just retry
+                time.sleep(0.0005)
+            dfleet.kill(0)           # SIGKILL the prefill replica
+            for i, h in enumerate(hs_d):
+                try:
+                    if not np.array_equal(h.result(timeout=300),
+                                          d_refs[1 + i]):
+                        problems.append(
+                            f"disagg migrated output {i} mismatch")
+                except Exception as e:
+                    problems.append(f"disagg migrated request {i} "
+                                    f"failed: {e}")
+            if dfleet.stats()["healthy_replicas"] != 1:
+                problems.append("disagg fleet survivor count != 1 "
+                                "after the prefill-replica kill")
+        if outcome_total("migrated") - migd0 >= 1:
+            break                    # the kill landed mid-handoff
+    else:
+        problems.append("prefill-replica kill never migrated a "
+                        "request (3 attempts)")
+
     # -- cross-worker trace store (ISSUE 13): the killed replica's
     # request crossed placements mid-decode — its spans (abandoned
     # victim placement INCLUDED, flushed by the owner-death path)
@@ -521,6 +582,11 @@ def main() -> int:
                    'fleet_resumes_total{outcome="resumed"}',
                    'fleet_elastic_resumes_total{direction="shrink"}',
                    "kv_slots_salvaged_total",
+                   # disagg handoff (ISSUE 14): the prefill->decode
+                   # block transfer + the decode-side tier restore
+                   # must carry real values after the disagg scenario
+                   "kv_handoff_blocks_total",
+                   "kv_tier_fetches_total",
                    "serve_watchdog_restarts_total",
                    # the step-load scenario's autoscale actions, both
                    # directions, on the wire (ISSUE 12)

@@ -82,6 +82,26 @@ PAGED_KV_SERIES = [
     'paged_route_total{path="reference"}',
 ]
 
+# Tiered-KV series (ISSUE 14): the smoke below drives two same-prefix
+# requests through a tier-sized-down pool — the interleaved distinct
+# prompt EVICTS the first's cached blocks (>= 1 real spill to host
+# RAM), and the re-admission restores them (>= 1 tier fetch, one
+# batched H2D) with the output byte-identical to the cold decode.
+# The handoff pair (export_prefix -> import_blocks into a second
+# server) puts real values on the kv_handoff_* counters.
+TIERED_KV_SERIES = [
+    # kv_pool_blocks_free itself stays in PAGED_KV_SERIES; this list
+    # adds the ISSUE 14 gauge-split + tier + handoff families
+    "kv_pool_blocks_evictable",
+    "kv_host_tier_blocks",
+    "kv_tier_spills_total",
+    "kv_tier_fetches_total",
+    "kv_tier_hits_total",
+    "kv_tier_evictions_total",
+    "kv_handoff_blocks_total",
+    "kv_handoff_bytes_total",
+]
+
 # Speculative-decode series (PR 11): the smoke below decodes through
 # a draft-verified server (full-depth self-draft -> acceptance is
 # exactly 1.0), so proposed/accepted and the acceptance-rate gauge
@@ -373,6 +393,54 @@ def main() -> int:
         problems.append("prefix-hit decode diverged from the cold "
                         "decode of the same prompt")
 
+    # -- tiered KV: a tier-backed server whose pool is too small for
+    # two working sets — the second distinct prompt EVICTS the first's
+    # cached blocks (spill to host RAM), the first's re-admission
+    # restores them with one batched H2D (tier fetch), outputs
+    # identical; then the prefix hands off to a SECOND server
+    # (export -> import) whose admission tier-fetches it ------------
+    t_spills = registry.counter("kv_tier_spills_total")
+    t_fetches = registry.counter("kv_tier_fetches_total")
+    t_handoff = registry.counter("kv_handoff_blocks_total")
+    ts0, tf0, th0 = t_spills.value, t_fetches.value, t_handoff.value
+    tp_a = np.asarray([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9],
+                      np.int32)
+    tp_b = np.asarray([2, 7, 1, 8, 2, 8, 1, 8, 2, 8, 4, 5, 9],
+                      np.int32)
+    with GenerationServer(gpt, n_slots=2, max_len=32, block_size=4,
+                          kv_blocks=8, host_tier_blocks=8,
+                          tick_timeout_s=None) as gt:
+        tier_a = gt.submit(tp_a, n_new=12, timeout=300)
+        gt.submit(tp_b, n_new=12, timeout=300)     # evicts A -> spill
+        if t_spills.value - ts0 < 1:
+            problems.append("tier-sized-down pool produced no "
+                            "kv_tier_spills_total increment")
+        tier_a2 = gt.submit(tp_a, n_new=12, timeout=300)  # tier fetch
+        if t_fetches.value - tf0 < 1:
+            problems.append("re-admission of the spilled prefix "
+                            "produced no kv_tier_fetches_total "
+                            "increment")
+        if not np.array_equal(tier_a, tier_a2):
+            problems.append("tier-fetch decode diverged from the cold "
+                            "decode of the same prompt")
+        handoff_payload = gt.export_prefix(tp_a)
+    if len(handoff_payload) != 3:
+        problems.append(f"export_prefix returned "
+                        f"{len(handoff_payload)} blocks, expected 3")
+    with GenerationServer(gpt, n_slots=2, max_len=32, block_size=4,
+                          tick_timeout_s=None) as gi:
+        gi.import_blocks(handoff_payload)
+        tier_a3 = gi.submit(tp_a, n_new=12, timeout=300)
+        if not np.array_equal(tier_a, tier_a3):
+            problems.append("handed-off decode diverged from the "
+                            "origin server's decode")
+        if gi.stats()["tier_fetches"] < 1:
+            problems.append("handoff admission restored no tier "
+                            "blocks on the importing server")
+    if t_handoff.value - th0 != 3:
+        problems.append("kv_handoff_blocks_total grew "
+                        f"{t_handoff.value - th0} != 3")
+
     # -- speculative decode: a draft-verified server must agree with
     # the plain server byte-for-byte AND count real proposals -------
     spec_prop = registry.counter(
@@ -593,8 +661,9 @@ def main() -> int:
         'phase="verify"',
         "fleet_xprof_captures_total",
         "fleet_xprof_capture_files",
-    ] + PAGED_KV_SERIES + SPEC_SERIES + FLEET_SERIES \
-      + RESILIENCE_SERIES + ANALYSIS_SERIES + FORECAST_SERIES
+    ] + PAGED_KV_SERIES + TIERED_KV_SERIES + SPEC_SERIES \
+      + FLEET_SERIES + RESILIENCE_SERIES + ANALYSIS_SERIES \
+      + FORECAST_SERIES
     problems += missing_series(body, required)
     if lat.count - lat_before != 16:
         problems.append(

@@ -628,6 +628,40 @@ class TestAnnotations:
         assert cls_of("int") == ""
 
 
+class TestKvTieringProbe:
+    """ISSUE 14: ``parallel/kv_tiering.py``'s host-tier LRU map is
+    cross-thread state (scheduler spills/fetches while router threads
+    import handoffs) — the CONC rules must SEE it.  Two probes: the
+    shipped module's lock discipline is clean, and stripping the lock
+    re-surfaces the violations (the rules are not blind to the
+    file)."""
+
+    PATH = os.path.join(REPO, "deeplearning4j_tpu", "parallel",
+                        "kv_tiering.py")
+
+    def test_shipped_module_is_conc_clean(self):
+        src = open(self.PATH).read()
+        fs = concurrency_lint.lint_source(
+            src, "deeplearning4j_tpu/parallel/kv_tiering.py")
+        assert fs == [], [f.render() for f in fs]
+
+    def test_rules_see_the_tier_state_when_unguarded(self):
+        # strip the guard from the public ``get`` reader only:
+        # ``put`` keeps its locked store, so ``_entries`` stays
+        # lock-guarded — the now-bare LRU-map reads in get() must
+        # surface as CONC202, proving the rules actually see the
+        # tier's shared state rather than skipping the module
+        head, _, tail = open(self.PATH).read().partition("def get")
+        src = head + "def get" + tail.replace("with self._lock:",
+                                              "if True:", 1)
+        fs = concurrency_lint.lint_source(
+            src, "deeplearning4j_tpu/parallel/kv_tiering.py")
+        hits = [f for f in fs if f.rule in ("CONC201", "CONC202")
+                and "_entries" in f.message]
+        assert hits, ("CONC rules are blind to kv_tiering's tier "
+                      f"state: {[f.render() for f in fs]}")
+
+
 # ---------------------------------------------------------------------------
 # whole-package: index, cross-module rules, cache
 # ---------------------------------------------------------------------------
