@@ -165,6 +165,11 @@ import numpy as np
 
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.analysis import sanitize as _sanitize
+
+#: the per-host flight recorder (ISSUE 15): admissions, retires,
+#: allocator spill/fetch and watchdog transitions land in the
+#: black-box ring a postmortem bundle freezes
+_FLIGHT = telemetry.get_flight_recorder()
 from deeplearning4j_tpu.models.generation import (TransformerGenerator,
                                                   _filter_logits_rows)
 from deeplearning4j_tpu.parallel import speculative as _speculative
@@ -1081,6 +1086,7 @@ class GenerationServer:
                 self._tier.put(hsh, tok, k, v)
                 self._n_tier_spills += 1
                 _TIER_SPILLS.inc()
+                _FLIGHT.record("kv_spill", block=int(blk))
         self._blocks_free.append(blk)
 
     def _plan_admission_locked(self, req: _Pending):
@@ -1967,6 +1973,12 @@ class GenerationServer:
                                    plan.reg_from + n_fills):
                         self._tier.touch(plan.hashes[j][0])
         _ADMITTED.inc()
+        _FLIGHT.record("admit", slot=slot, trace=req.trace_id,
+                       t0=req.t0, n_new=req.n_new, cached=matched,
+                       tier_fills=n_fills,
+                       prefill_only=bool(req.prefill_only))
+        if n_fills:
+            _FLIGHT.record("kv_fetch", slot=slot, blocks=n_fills)
         if matched:
             _PREFIX_HITS.inc()
             # device-map hits are COPY-FREE shares; tier restores are
@@ -2005,6 +2017,10 @@ class GenerationServer:
                 time.perf_counter() - req._t_decode)
         req.close_spans("ok" if error is None else type(error).__name__)
         _RETIRED.inc()
+        _FLIGHT.record("retire", slot=slot, trace=req.trace_id,
+                       emitted=req.emitted,
+                       error=(None if error is None
+                              else type(error).__name__))
         req._event.set()
 
     def _reap_pending_locked(self, now: float):
@@ -2651,6 +2667,8 @@ class GenerationServer:
                     if self._epoch != my_epoch:
                         return
                 _TICK_FAILURES.inc()
+                _FLIGHT.record("tick_failure",
+                               error=type(e).__name__)
                 err = RetryableServerError(
                     "decode dispatch failed and the slot pool was "
                     "rebuilt; the request was not applied — safe to "
@@ -2713,9 +2731,16 @@ class GenerationServer:
         # scheduler, failed ones close at _retire).  Keyed by the
         # superseded INCARNATION (id, epoch), never a raw thread
         # ident — dead threads' idents are recycled.
+        _WATCHDOG_RESTARTS.inc()
+        _FLIGHT.record("watchdog", reason=reason,
+                       epoch=int(new_epoch))
+        # freeze the black box BEFORE the owner-death span flush and
+        # the pool rebuild: the bundle must hold the hung dispatch's
+        # still-open tick span and the pre-recovery ring — the "what
+        # was it doing" a postmortem exists to answer
+        _FLIGHT.request_dump(f"watchdog: {reason}")
         telemetry.get_tracer().end_owned_by(
             (id(self), new_epoch - 1), error="watchdog_recovery")
-        _WATCHDOG_RESTARTS.inc()
         log.warning("GenerationServer watchdog: %s — salvaging "
                     "unaffected slots and restarting the scheduler",
                     reason)

@@ -64,6 +64,9 @@ _TIER_EVICTED = telemetry.counter(
     "host-tier entries dropped by the tier's OWN capacity LRU (the "
     "block is now gone from both tiers — the next same-prefix "
     "admission re-prefills)")
+#: flight recorder (ISSUE 15): capacity evictions are the allocator
+#: decisions a postmortem wants beside the server's spill/fetch events
+_FLIGHT = telemetry.get_flight_recorder()
 
 
 class HostKVTier:
@@ -108,6 +111,8 @@ class HostKVTier:
             n_resident = len(self._entries)
         if n_evicted:
             _TIER_EVICTED.inc(n_evicted)
+            _FLIGHT.record("tier_evict", evicted=n_evicted,
+                           resident=n_resident)
         _TIER_BLOCKS.set(n_resident)
         return n_evicted
 

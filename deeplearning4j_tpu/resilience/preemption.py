@@ -60,8 +60,32 @@ def poll_preemption() -> bool:
     which the synchronous training loop guarantees)."""
     coordinator = _COORDINATOR
     if coordinator is None:
-        return _FLAG.is_set()
-    return coordinator.poll(_FLAG.is_set())
+        preempted = _FLAG.is_set()
+    else:
+        preempted = coordinator.poll(_FLAG.is_set())
+    if preempted:
+        _dump_once()
+    return preempted
+
+
+#: one postmortem bundle per preemption round — reset by
+#: clear_preemption so the next simulated/real preemption dumps again
+_DUMPED = threading.Event()
+
+
+def _dump_once() -> None:
+    """Freeze the flight recorder's black box for this preemption —
+    called from the STEP-BOUNDARY poll, never from the signal handler
+    (record/dump take ordinary locks and do file I/O; running them in
+    async-signal context could deadlock against whatever metric lock
+    the interrupted frame holds — the exact reentrancy hazard this
+    module's flag-only handler design exists to avoid)."""
+    if _DUMPED.is_set():
+        return
+    _DUMPED.set()
+    recorder = telemetry.get_flight_recorder()
+    recorder.record("preemption")
+    recorder.request_dump("preemption")
 
 
 def request_preemption(signum=None, frame=None) -> None:
@@ -72,6 +96,9 @@ def request_preemption(signum=None, frame=None) -> None:
                     "checkpoint and exit at the next step boundary",
                     signum)
     _FLAG.set()
+    # flight recorder (ISSUE 15): the bundle dump happens at the next
+    # step-boundary poll (_dump_once), NOT here — the handler stays
+    # flag-only, exactly as the module docstring demands
 
 
 def preemption_requested() -> bool:
@@ -80,6 +107,7 @@ def preemption_requested() -> bool:
 
 def clear_preemption() -> None:
     _FLAG.clear()
+    _DUMPED.clear()
 
 
 class PreemptionGuard:

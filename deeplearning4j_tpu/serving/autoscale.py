@@ -40,11 +40,22 @@ a scheduler-style cadence and drives the fleet:
   itself is observable (``fleet_autoscale_forecast{signal=}``,
   ``fleet_autoscale_prewarms_total``).
 
+* **SLO burn-rate pre-warm** (ISSUE 15) — with an
+  :class:`~deeplearning4j_tpu.telemetry.slo.AlertEngine` attached
+  (``alert_engine=``, or its ``fleet_slo_alert_firing`` gauge on the
+  scraped view), a FIRING alert is up-pressure STRONGER than the
+  forecaster: a measured budget burn opens the streak gate
+  immediately (the engine's multi-window + ``for_s`` hysteresis
+  already damped it; cooldown still applies), and budget-EXHAUSTED
+  batch tenants defer/shed first when pressure persists at
+  ``max_replicas``.
+
 Telemetry: ``fleet_autoscale_actions_total{direction=}``,
 ``fleet_autoscale_{deferred,shed}_total{tenant=}``,
 ``fleet_autoscale_replicas_target``, ``fleet_autoscale_pressure``,
 ``fleet_autoscale_forecast{signal=}``,
-``fleet_autoscale_prewarms_total``.
+``fleet_autoscale_prewarms_total``,
+``fleet_autoscale_alert_prewarms_total``.
 """
 from __future__ import annotations
 
@@ -91,6 +102,12 @@ _PREWARM = telemetry.counter(
     "fleet_autoscale_prewarms_total",
     "scale-ups taken on the FORECAST alone — a replica pre-warmed "
     "before any reactive SLO signal tripped")
+_ALERT_PREWARM = telemetry.counter(
+    "fleet_autoscale_alert_prewarms_total",
+    "scale-ups attributed to a FIRING SLO burn-rate alert while "
+    "every reactive signal was quiet (ISSUE 15) — the error-budget "
+    "engine pre-warmed the replica before the reactive loop could "
+    "see the breach")
 
 
 class AutoscalePolicy:
@@ -296,10 +313,20 @@ class Autoscaler:
     def __init__(self, fleet, policy: Optional[AutoscalePolicy] = None,
                  source=None, interval_s: float = 0.5,
                  tenant_classes: Optional[Dict[str, str]] = None,
-                 remove_timeout_s: float = 30.0):
+                 remove_timeout_s: float = 30.0,
+                 alert_engine=None):
         self.fleet = fleet
         self.policy = policy or AutoscalePolicy()
         self.source = source
+        # SLO burn-rate engine (ISSUE 15): attached, the autoscaler
+        # DRIVES its evaluation each pass and treats a firing alert
+        # as scale-up pressure STRONGER than the forecaster (the
+        # streak gate opens immediately — the engine's own for_s /
+        # multi-window hysteresis already damped it; cooldown still
+        # applies).  Without an attached engine the same signal is
+        # read from the fleet_slo_alert_firing gauge, so alerts
+        # beaconed from OTHER hosts steer this loop too.
+        self.alert_engine = alert_engine
         self.interval_s = float(interval_s)
         if self.interval_s <= 0:
             raise ValueError("interval_s must be > 0")
@@ -329,24 +356,18 @@ class Autoscaler:
         src = self.source
         if src is None:
             return telemetry.get_registry()
-        view = getattr(src, "view", None)
-        if callable(view):
-            if getattr(src, "directory", None) is not None:
-                src.refresh()
-            return view()
-        return src
+        from deeplearning4j_tpu.telemetry.fleet import resolve_view
+        return resolve_view(src)
 
     @staticmethod
     def _children(fam):
-        """The children to read: against an aggregated view (a
-        ``host`` label is present) only the ``host="fleet"`` rollups —
-        per-host series would double-count; against a plain registry,
-        every child."""
-        items = fam._items()
-        if "host" in fam.labelnames:
-            hidx = fam.labelnames.index("host")
-            items = [(lv, c) for lv, c in items if lv[hidx] == "fleet"]
-        return items
+        """The children to read — the shared rollup-selection rule
+        (host="fleet" children on aggregated views, every child on a
+        plain registry); ONE encoding lives in
+        ``telemetry.fleet.rollup_children``, shared with the SLO
+        engine so the two readers can never drift apart."""
+        from deeplearning4j_tpu.telemetry.fleet import rollup_children
+        return rollup_children(fam)
 
     def _gauge_sum(self, reg, name: str) -> Optional[float]:
         fam = reg.get(name)
@@ -489,6 +510,25 @@ class Autoscaler:
         if pol.free_blocks_floor and free_blocks is not None \
                 and free_blocks < pol.free_blocks_floor:
             up_reasons.append(f"free_blocks={free_blocks:g}")
+        # SLO burn-rate alert (ISSUE 15): a firing alert is a
+        # MEASURED budget burn, not a projection — it outranks the
+        # forecaster below.  alert_only records whether an eventual
+        # up action is attributable to the alert alone.
+        alert_firing = False
+        if self.alert_engine is not None:
+            try:
+                self.alert_engine.evaluate(reg, now=now)
+            except Exception:
+                log.exception("autoscaler: alert-engine evaluation "
+                              "failed")
+            alert_firing = self.alert_engine.any_firing()
+        else:
+            alert_firing = bool(
+                self._gauge_sum(reg, "fleet_slo_alert_firing") or 0.0)
+        alert_only = False
+        if alert_firing:
+            alert_only = not up_reasons
+            up_reasons.append("slo_burn_alert")
         # predictive pre-warm (ISSUE 13): the forecast fires BEFORE
         # any reactive signal, but through the same streak/cooldown
         # gate — prediction adds lead time, never a new flap mode.
@@ -529,6 +569,13 @@ class Autoscaler:
             if up_reasons:
                 self._up_streak += 1
                 self._down_streak = 0
+                if alert_firing:
+                    # stronger than the forecaster: the engine's own
+                    # multi-window + for_s hysteresis already proved
+                    # the burn is sustained — re-proving it through
+                    # the streak would just delay the pre-warm
+                    self._up_streak = max(self._up_streak,
+                                          pol.up_consecutive)
             elif down_ok:
                 self._down_streak += 1
                 self._up_streak = 0
@@ -582,6 +629,14 @@ class Autoscaler:
                 # would be crossed — the pre-warm the predictive path
                 # is for
                 _PREWARM.inc()
+            if alert_only:
+                # attributed to the burn-rate alert: the budget was
+                # measurably burning while every reactive signal was
+                # still quiet (ISSUE 15's closed loop)
+                _ALERT_PREWARM.inc()
+            telemetry.get_flight_recorder().record(
+                "scale", action="up", target=int(target),
+                replica=int(idx), reasons=", ".join(up_reasons))
             log.info("autoscaler: scaled UP to %d (replica %d)%s: %s",
                      target, idx,
                      " [predictive pre-warm]" if forecast_only else "",
@@ -607,26 +662,51 @@ class Autoscaler:
                 log.exception("autoscaler: remove_replica(%d) failed",
                               remove_idx)
             _ACTIONS.labels(direction="down").inc()
+            telemetry.get_flight_recorder().record(
+                "scale", action="down", target=int(target),
+                replica=int(remove_idx))
             log.info("autoscaler: scaled DOWN to %d (removed replica "
                      "%d)", target, remove_idx)
         elif action == "defer":
-            for t in self.batch_tenants:
+            targets = self._batch_targets(shed=False)
+            for t in targets:
                 n = self.fleet.demote_waiting(
                     (t,), priority=self.policy.defer_priority)
                 if n:
                     _DEFERRED.labels(tenant=t).inc(n)
+            telemetry.get_flight_recorder().record(
+                "scale", action="defer", tenants=",".join(targets))
             log.warning("autoscaler: at max_replicas under pressure "
                         "(%s) — deferring batch tenants %s",
-                        ", ".join(up_reasons), self.batch_tenants)
+                        ", ".join(up_reasons), targets)
         elif action == "shed":
-            for t in self.batch_tenants:
+            targets = self._batch_targets(shed=True)
+            for t in targets:
                 n = self.fleet.demote_waiting((t,), cancel=True)
                 if n:
                     _SHED.labels(tenant=t).inc(n)
+            telemetry.get_flight_recorder().record(
+                "scale", action="shed", tenants=",".join(targets))
             log.warning("autoscaler: pressure persisted after "
                         "deferral — shedding batch tenants %s",
-                        self.batch_tenants)
+                        targets)
         return action
+
+    def _batch_targets(self, shed: bool) -> List[str]:
+        """Batch tenants ordered budget-exhausted FIRST (ISSUE 15:
+        the tenant that already spent its error budget pays before
+        one still within budget).  Shedding goes further: while ANY
+        batch tenant is exhausted, only the exhausted ones are
+        cancelled this round — within-budget batch work keeps its
+        deferred place in line."""
+        exh = set()
+        if self.alert_engine is not None:
+            exh = set(self.alert_engine.exhausted_tenants())
+        if shed:
+            hit = [t for t in self.batch_tenants if t in exh]
+            return hit or list(self.batch_tenants)
+        return sorted(self.batch_tenants,
+                      key=lambda t: (t not in exh, t))
 
     @staticmethod
     def _decode_capable(r: dict) -> bool:

@@ -168,6 +168,17 @@ _EDF_SLACK = telemetry.histogram(
     "remaining deadline budget at dispatch, per tenant — the EDF "
     "slack whose low percentiles collapsing toward 0 are the "
     "autoscaler's scale-up pressure", labelnames=("tenant",))
+_SLO_DEFER = telemetry.counter(
+    "fleet_slo_budget_deferrals_total",
+    "waiting requests demoted behind within-budget tenants because "
+    "their tenant's SLO error budget is exhausted (ISSUE 15: "
+    "budget-exhausted batch work defers BEFORE any interactive "
+    "tenant is shed)", labelnames=("tenant",))
+
+#: the per-host flight recorder (ISSUE 15): placement decisions,
+#: migrations, handoffs and chaos kills land in the black-box ring a
+#: postmortem bundle freezes
+_FLIGHT = telemetry.get_flight_recorder()
 
 #: intake sentinel that wakes the scheduler without meaning "stop"
 _WAKE = object()
@@ -194,8 +205,8 @@ class _FleetRequest:
                  "inner", "ttft", "trace_id", "spans", "stage",
                  "handoff", "prefill_replica", "_t_dispatch",
                  "_not_before", "_migrate", "_quota_held",
-                 "_queued_counted", "_migrating", "_result", "_error",
-                 "_event")
+                 "_queued_counted", "_migrating", "_budget_deferred",
+                 "_result", "_error", "_event")
 
     def __init__(self, prompt, n_new, eos_id, seed, sampling, tenant,
                  priority, cost, deadline):
@@ -237,6 +248,9 @@ class _FleetRequest:
                                       # charge)
         self._queued_counted = False
         self._migrating = False       # next dispatch is a failover
+        self._budget_deferred = False  # counted once per request when
+                                       # its tenant's exhausted error
+                                       # budget demotes it in line
         self._result = None
         self._error = None
         self._event = threading.Event()
@@ -322,6 +336,7 @@ class ServingFleet:
                  queue_limit: int = 4096,
                  roles: Optional[Iterable[str]] = None,
                  prefill_threshold: Optional[int] = None,
+                 slo_engine=None,
                  **server_kwargs):
         self.n_replicas = int(n_replicas)
         if self.n_replicas < 1:
@@ -367,6 +382,12 @@ class ServingFleet:
             int(prefill_threshold) if prefill_threshold is not None
             else 2 * self._servers[0].block_size + 1)
         self._acct = TenantAccountant(default_quota, quotas)
+        # SLO error-budget engine (ISSUE 15): when attached (here or
+        # via attach_slo), each dispatch pass reads its exhausted-
+        # tenant set and demotes those tenants' waiting work WITHIN
+        # its priority class — budget-exhausted batch traffic defers
+        # before any interactive tenant would be shed
+        self._slo = slo_engine
         # fleet scheduler state: everything below mutates ONLY under
         # _lock (the GenerationServer discipline, one level up)
         self._lock = threading.RLock()
@@ -536,6 +557,15 @@ class ServingFleet:
         with self._lock:
             already = idx in self._dead
             self._dead.add(idx)
+        if not already:
+            # the kill IS a crash drill: freeze the black box NOW,
+            # while the victim's in-flight requests' spans are still
+            # open — the bundle is the forensic record the migration
+            # then outruns.  First kill only: a repeated kill of a
+            # corpse must not bury the real crash bundle under an
+            # empty post-recovery one.
+            _FLIGHT.record("chaos_kill", replica=idx)
+            _FLIGHT.request_dump(f"chaos_kill: replica {idx}")
         self._mark_migrate(idx)
         if not already:
             # hard stop: in-flight handles fail immediately (the
@@ -625,6 +655,13 @@ class ServingFleet:
         except Exception:
             log.exception("removed replica %d shutdown failed", idx)
         self._wake()
+
+    def attach_slo(self, engine) -> None:
+        """Attach (or replace; None detaches) the SLO error-budget
+        engine consulted by every dispatch pass (ISSUE 15) — see
+        ``slo_engine=`` on the constructor."""
+        with self._lock:
+            self._slo = engine
 
     def demote_waiting(self, tenants: Iterable[str],
                        priority: Optional[int] = None,
@@ -956,11 +993,28 @@ class ServingFleet:
         traffic, decode replicas never take prefill stages, unified
         replicas take only decode/direct traffic (a unified replica
         IS its own prefill)."""
+        budget_deferred: List[str] = []
         with self._lock:
             if not self._waiting:
                 return 0
+            # SLO budget defer (ISSUE 15): tenants whose error budget
+            # is exhausted sort BEHIND within-budget tenants of the
+            # same priority class — their backlog waits out the burn
+            # instead of forcing the autoscaler to shed interactive
+            # work.  The engine lock is a leaf (it never calls back
+            # into the fleet), so the nested read cannot deadlock.
+            slo = self._slo
+            exhausted = (slo.exhausted_tenants()
+                         if slo is not None else frozenset())
+            if exhausted:
+                for req in self._waiting:
+                    if req.tenant in exhausted \
+                            and not req._budget_deferred:
+                        req._budget_deferred = True
+                        budget_deferred.append(req.tenant)
             line = sorted(self._waiting,
                           key=lambda r: (r.priority,
+                                         r.tenant in exhausted,
                                          r.deadline if r.deadline
                                          is not None else _INF,
                                          r.t_submit_m))
@@ -978,6 +1032,8 @@ class ServingFleet:
                     if i not in self._dead and i not in self._draining
                     and i not in self._removed
                     and i not in self._joining]
+        for t in budget_deferred:
+            _SLO_DEFER.labels(tenant=t).inc()
         pre_cand = [i for i in cand if roles[i] == ROLE_PREFILL]
         base, pbase = {}, {}
         for i in cand:
@@ -1163,6 +1219,9 @@ class ServingFleet:
                 self._inflight.append(req)
             first = req._t_dispatch is None
             req._t_dispatch = time.perf_counter()
+            _FLIGHT.record("dispatch", replica=idx, reason=reason,
+                           trace=req.trace_id, tenant=req.tenant,
+                           stage=req.stage or "decode")
             sp_place.end(replica=idx, reason=reason)
             _PHASE.labels(phase="placement").observe(
                 req._t_dispatch - t_place)
@@ -1270,6 +1329,9 @@ class ServingFleet:
         except Exception:
             log.exception("prefix export off replica %s failed; the "
                           "decode stage will re-prefill", req.replica)
+        _FLIGHT.record("handoff", trace=req.trace_id,
+                       off_replica=req.replica,
+                       blocks=len(payload or ()))
         with self._lock:
             if req in self._inflight:
                 self._inflight.remove(req)
@@ -1345,6 +1407,9 @@ class ServingFleet:
 
     def _requeue(self, req: _FleetRequest, now: float) -> None:
         req.migrations += 1
+        _FLIGHT.record("migrate", trace=req.trace_id,
+                       tenant=req.tenant, off_replica=req.replica,
+                       migrations=req.migrations)
         delay = backoff_delay(req.migrations - 1,
                               self.retry_backoff_s, 1.0)
         inner = req.inner

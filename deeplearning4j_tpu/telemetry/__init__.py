@@ -23,7 +23,18 @@ pieces, all stdlib-only:
   into ``fleet_device_phase_seconds{device=,phase=}`` plus an
   on-demand XProf capture trigger whose summary beacons fleet-wide;
   the beacons also ship closed request spans, which ``FleetRegistry``
-  stitches into per-request trees in a ``FleetTraceStore``.
+  stitches into per-request trees in a ``FleetTraceStore``;
+* ``slo``       — the plane's CONSUMER (ISSUE 15): declarative
+  ``SLOSpec`` objectives over the already-emitted request series, an
+  error-budget accountant, and a multi-window burn-rate
+  ``AlertEngine`` whose state is ordinary metric families (beacons
+  like everything else) and serves as JSON at ``/alerts``;
+* ``flightrec`` — the per-host black box (ISSUE 15): a lock-cheap
+  bounded ring of admission/dispatch/spill/watchdog/scale events;
+  watchdog trips, chaos kills and preemptions freeze it — with the
+  tracer's open spans, a metric snapshot and the SLO state — into
+  atomic postmortem bundles ``scripts/postmortem.py`` renders as a
+  merged timeline.
 
 Instrumented in-tree: ``optimize.fit_loop`` (step/data-wait split,
 iteration/epoch/example counters), ``parallel.trainer`` and
@@ -54,10 +65,13 @@ from deeplearning4j_tpu.telemetry.listener import TelemetryListener
 from deeplearning4j_tpu.telemetry.fleet import (
     FleetRegistry, MetricsBeacon, exchange_snapshots, publish_beacon)
 from deeplearning4j_tpu.telemetry.profiling import DeviceProfiler
+from deeplearning4j_tpu.telemetry.flightrec import FlightRecorder
+from deeplearning4j_tpu.telemetry.slo import AlertEngine, SLOSpec
 
 _REGISTRY = MetricsRegistry()
 _TRACER = SpanTracer()
 _PROFILER = DeviceProfiler(_REGISTRY)
+_FLIGHTREC = FlightRecorder()
 
 
 def get_registry() -> MetricsRegistry:
@@ -74,6 +88,13 @@ def get_profiler() -> DeviceProfiler:
     """The process-wide sampling device profiler (ISSUE 13) the hot
     dispatch sites report into."""
     return _PROFILER
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """The process-wide flight recorder (ISSUE 15): the bounded ring
+    of admission/dispatch/spill/watchdog/scale events the hot sites
+    feed, and the postmortem-bundle writer the crash paths trip."""
+    return _FLIGHTREC
 
 
 def counter(name: str, documentation: str = "",
@@ -102,7 +123,8 @@ __all__ = [
     "Span", "MetricsServer", "start_metrics_server", "TelemetryListener",
     "FleetRegistry", "FleetTraceStore", "MetricsBeacon", "publish_beacon",
     "exchange_snapshots", "parse_series", "DeviceProfiler",
+    "FlightRecorder", "AlertEngine", "SLOSpec",
     "DEFAULT_BUCKETS", "RATIO_BUCKETS",
-    "get_registry", "get_tracer", "get_profiler",
+    "get_registry", "get_tracer", "get_profiler", "get_flight_recorder",
     "counter", "gauge", "histogram", "span",
 ]

@@ -10,10 +10,21 @@ When the served registry carries a cross-worker trace store (a
 ``FleetRegistry`` — ISSUE 13), the same endpoint also answers
 ``/traces`` (store summary + trace ids) and ``/traces?id=<trace>``
 (ONE stitched submit->retire tree as JSON) — the query surface the
-trace store exists for.
+trace store exists for.  When it carries an SLO alert engine
+(``FleetRegistry(alerts=...)`` or a plain registry with an
+``.alerts`` attribute — ISSUE 15), ``/alerts`` serves the engine's
+state (burn rates, budgets, firing alerts) as JSON, evaluated against
+the served view per request like a scrape.
+
+Error discipline (ISSUE 15): unknown paths answer a REAL 404 with a
+JSON body naming the endpoints, malformed queries answer 400 with a
+JSON error, and a handler exception answers 500 with the error name —
+a scrape surface must never push a stack trace down the wire.
 """
 from __future__ import annotations
 
+import json
+import logging
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -21,7 +32,10 @@ from typing import Optional
 
 from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
 
+log = logging.getLogger("deeplearning4j_tpu")
+
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+JSON_TYPE = "application/json"
 
 
 class MetricsServer:
@@ -29,7 +43,8 @@ class MetricsServer:
     (read it back from ``.port`` — what tests and the smoke script use).
 
     >>> srv = MetricsServer(registry, port=9464).start()
-    >>> # curl localhost:9464/metrics
+    >>> # curl localhost:9464/metrics   (+ /traces, /alerts where
+    >>> #                                the registry carries them)
     >>> srv.close()
     """
 
@@ -45,31 +60,94 @@ class MetricsServer:
         registry = self.registry
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
-                path = self.path.split("?")[0]
-                traces = getattr(registry, "traces", None)
-                if path == "/traces" and traces is not None:
-                    # fold the latest beacons in first, like a scrape
-                    refresh = getattr(registry, "refresh", None)
-                    if callable(refresh) and getattr(
-                            registry, "directory", None) is not None:
-                        refresh()
-                    q = urllib.parse.parse_qs(
-                        urllib.parse.urlparse(self.path).query)
-                    tid = q.get("id", [None])[0]
-                    body = traces.render_json(tid).encode()
-                    ctype = "application/json"
-                elif path in ("/metrics", "/"):
-                    body = registry.render_prometheus().encode()
-                    ctype = CONTENT_TYPE
-                else:
-                    self.send_error(404)
-                    return
-                self.send_response(200)
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _send_json(self, code: int, doc: dict) -> None:
+                self._send(code, json.dumps(doc).encode(), JSON_TYPE)
+
+            def _refresh(self) -> None:
+                """Fold the latest beacons in first, like a scrape
+                (directory-backed ``FleetRegistry`` only)."""
+                refresh = getattr(registry, "refresh", None)
+                if callable(refresh) and getattr(
+                        registry, "directory", None) is not None:
+                    refresh()
+
+            def do_GET(self):
+                try:
+                    self._route()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass             # client went away mid-write
+                                     # (scrape timeout RST included)
+                except Exception as e:
+                    # never a stack trace down the wire: the scrape
+                    # surface degrades to a typed JSON error
+                    log.exception("metrics endpoint %s failed",
+                                  self.path)
+                    try:
+                        self._send_json(500, {
+                            "error": type(e).__name__,
+                            "detail": str(e)})
+                    except Exception:
+                        pass
+
+            def _route(self):
+                parsed = urllib.parse.urlparse(self.path)
+                path = parsed.path
+                traces = getattr(registry, "traces", None)
+                alerts = getattr(registry, "alerts", None)
+                if path == "/traces" and traces is not None:
+                    q = urllib.parse.parse_qs(
+                        parsed.query, keep_blank_values=True)
+                    unknown = sorted(set(q) - {"id"})
+                    ids = q.get("id", [])
+                    if unknown or len(ids) > 1 or (ids and not ids[0]):
+                        # malformed query: a 400 with a JSON body, not
+                        # a silent default and never a stack trace
+                        self._send_json(400, {
+                            "error": "bad_query",
+                            "detail": ("unknown parameter(s) "
+                                       f"{unknown}" if unknown else
+                                       "id must be given exactly once "
+                                       "with a non-empty value"),
+                            "usage": "/traces or /traces?id=<trace>"})
+                        return
+                    self._refresh()
+                    body = traces.render_json(
+                        ids[0] if ids else None).encode()
+                    self._send(200, body, JSON_TYPE)
+                elif path == "/alerts" and alerts is not None:
+                    # evaluated against the served view per request —
+                    # the scrape IS the evaluation cadence, exactly
+                    # like a Prometheus rule group.  A FleetRegistry
+                    # evaluates its attached engine INSIDE view(), so
+                    # building the view is the whole pass — a second
+                    # explicit evaluate would double the work and the
+                    # sample density per scrape.
+                    view = getattr(registry, "view", None)
+                    if callable(view):
+                        self._refresh()
+                        view()
+                    else:
+                        alerts.evaluate(registry)
+                    self._send(200, alerts.render_json().encode(),
+                               JSON_TYPE)
+                elif path in ("/metrics", "/"):
+                    body = registry.render_prometheus().encode()
+                    self._send(200, body, CONTENT_TYPE)
+                else:
+                    endpoints = ["/metrics"]
+                    if traces is not None:
+                        endpoints.append("/traces")
+                    if alerts is not None:
+                        endpoints.append("/alerts")
+                    self._send_json(404, {"error": "not_found",
+                                          "endpoints": endpoints})
 
             def log_message(self, *a):  # keep scrapes out of stderr
                 pass

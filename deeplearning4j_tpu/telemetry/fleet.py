@@ -93,6 +93,38 @@ def beacon_path(directory, host: str) -> str:
                         f"{host}.json")
 
 
+def resolve_view(source):
+    """Resolve a signal source to a readable registry: a
+    ``FleetRegistry``-shaped object (callable ``view``) is refreshed
+    (when directory-backed) and aggregated; anything else is already
+    a registry.  Shared by the fleet-aware readers (autoscaler, SLO
+    engine) so the view-resolution protocol has ONE encoding —
+    exposition's ``/traces`` handler deliberately refreshes WITHOUT
+    building a view (the trace store, not the metric families, is
+    its product)."""
+    view = getattr(source, "view", None)
+    if callable(view):
+        if getattr(source, "directory", None) is not None:
+            source.refresh()
+        return view()
+    return source
+
+
+def rollup_children(fam):
+    """The children a fleet-aware signal reader consumes from one
+    metric family: against an AGGREGATED view (a ``host`` label is
+    present) only the ``host="fleet"`` rollups — per-host series
+    would double-count; against a plain process registry, every
+    child.  THE one encoding of the rollup convention — the
+    autoscaler and the SLO engine both read through it, so a change
+    to the scheme cannot desynchronize them."""
+    items = fam._items()
+    if "host" in fam.labelnames:
+        hidx = fam.labelnames.index("host")
+        items = [(lv, c) for lv, c in items if lv[hidx] == "fleet"]
+    return items
+
+
 def publish_beacon(directory, host: Optional[str] = None,
                    registry: Optional[MetricsRegistry] = None,
                    snapshot: Optional[dict] = None,
@@ -263,7 +295,8 @@ class FleetRegistry:
     in wholesale instead of as a negative delta."""
 
     def __init__(self, directory=None, stale_after_s: float = 10.0,
-                 trace_store: Optional[FleetTraceStore] = None):
+                 trace_store: Optional[FleetTraceStore] = None,
+                 alerts=None):
         self.directory = str(directory) if directory is not None else None
         self.stale_after_s = float(stale_after_s)
         self._lock = threading.Lock()
@@ -272,6 +305,11 @@ class FleetRegistry:
         # beside the metric snapshots (own lock, own dedup)
         self.traces = (trace_store if trace_store is not None
                        else FleetTraceStore())
+        # SLO alert engine (ISSUE 15): attached, it evaluates against
+        # every built view — the scrape IS its evaluation cadence —
+        # and exports its burn/budget/state families into the view,
+        # so /metrics and /alerts answer from the SAME aggregation
+        self.alerts = alerts
 
     # -- fold ----------------------------------------------------------
     def ingest(self, host: str, snapshot: dict,
@@ -498,6 +536,15 @@ class FleetRegistry:
             "rooted trace can still report complete=false at /traces "
             "if stray same-host fragments fall outside the root)").set(
                 ts["rooted"])
+        view.counter(
+            "fleet_trace_store_evicted_total",
+            "trace trees the store evicted — retired-trace retention "
+            "(LRU by retire time) plus the max_traces capacity bound "
+            "— so sustained traffic cannot grow the aggregator "
+            "without end").inc(ts["evicted"])
+        if self.alerts is not None:
+            self.alerts.evaluate(view, now=now)
+            self.alerts.export(view)
         return view
 
     @staticmethod

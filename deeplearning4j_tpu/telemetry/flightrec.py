@@ -1,0 +1,358 @@
+"""Per-host flight recorder + crash-forensics postmortem bundles.
+
+When a replica dies, the only forensic record used to be whatever
+scrape happened to run last — counters say HOW MUCH, never WHAT
+HAPPENED LAST.  This module is the black box: a lock-cheap bounded
+ring of structured events fed by the hot decision sites the stack
+already has (admission/dispatch/placement, allocator spill/fetch,
+watchdog transitions, migrations, scale actions), plus the bundle
+writer that freezes the ring — with the tracer's OPEN spans, a final
+metric snapshot and the SLO/alert state — into one atomic postmortem
+document a later process can render as a timeline
+(``scripts/postmortem.py``).
+
+* **ring** — :meth:`FlightRecorder.record` appends one dict to a
+  bounded ``collections.deque`` (appends are atomic under the GIL; no
+  lock on the hot path) stamped with a process-monotonic ``seq``, a
+  wall clock and a monotonic clock.  Overflow drops the OLDEST events
+  — the last N decisions before a crash are exactly what a postmortem
+  needs;
+
+* **bundles** — :meth:`request_dump` (armed by :meth:`install_dump`)
+  writes ``<shared_dir>/_postmortem/<host>-<pid>-<n>.json`` through
+  ``resilience.atomic_publish_json`` — a reader sees a complete
+  bundle or none.  Dump triggers in-tree: the decode server's
+  watchdog recovery, ``ServingFleet.kill`` (chaos), cooperative
+  preemption, and any explicit call;
+
+* **black box persistence** — a SIGKILL runs no handlers, so
+  ``install_dump(..., persist_interval_s=...)`` starts a daemon that
+  periodically publishes the CURRENT ring + open spans to
+  ``_flightrec/<host>.json`` (same atomic publish).  After the kill,
+  :func:`salvage_bundles` promotes each black-box file whose
+  (host, pid) never produced a real bundle into a
+  ``reason="salvaged: ..."`` postmortem — the victim's last persisted
+  events and still-open spans survive their process.
+
+The recorder's own traffic is observable
+(``flight_events_total{kind=}``, ``postmortem_bundles_total``), and
+``record()`` stays cheap enough for per-request sites: one dict, one
+deque append, one counter inc.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: bundle subdirectory under the shared dir (beside ``_telemetry``
+#: and ``_rendezvous``, never inside them)
+BUNDLE_DIRNAME = "_postmortem"
+#: black-box ring snapshots (periodic persistence for SIGKILL cases)
+BLACKBOX_DIRNAME = "_flightrec"
+
+
+def _default_host_id() -> str:
+    return f"{os.uname().nodename}-{os.getpid()}"
+
+
+class FlightRecorder:
+    """Bounded ring of structured events + the postmortem bundle
+    writer.
+
+    >>> fr = FlightRecorder(capacity=4096)
+    >>> fr.record("dispatch", replica=1, reason="affinity")
+    >>> fr.install_dump(shared_dir, host="host000")
+    >>> fr.request_dump("watchdog: stuck tick")   # -> bundle path
+
+    ``record`` is safe from any thread without taking the recorder's
+    lock (deque appends are atomic); only the dump CONFIGURATION is
+    lock-guarded.  ``enabled=False`` turns every method into a no-op
+    (capacity stays allocated)."""
+
+    def __init__(self, capacity: int = 4096, enabled: bool = True):
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.enabled = bool(enabled)
+        self._events: deque = deque(maxlen=self.capacity)
+        self._seq = itertools.count()
+        self._dump_seq = itertools.count()
+        self._lock = threading.Lock()
+        self._cfg: Optional[dict] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ctr = None             # lazy: telemetry imports this
+        self._bundles = None         # module, not the reverse
+
+    # -- the ring ------------------------------------------------------
+    def record(self, kind: str, **fields) -> None:
+        """Append one event.  ``fields`` must be JSON-serializable
+        (ints/floats/strings — the hot sites pass ids and labels, not
+        arrays)."""
+        if not self.enabled:
+            return
+        ev = {"seq": next(self._seq), "wall": time.time(),
+              "ts": time.monotonic(), "kind": str(kind)}
+        ev.update(fields)
+        self._events.append(ev)
+        ctr = self._ctr
+        if ctr is None:
+            try:
+                from deeplearning4j_tpu import telemetry
+                ctr = self._ctr = telemetry.counter(
+                    "flight_events_total",
+                    "structured events recorded into the per-host "
+                    "flight-recorder ring, by kind",
+                    labelnames=("kind",))
+            except Exception:     # partially-imported package: the
+                return            # ring keeps the event regardless
+        ctr.labels(kind=str(kind)).inc()
+
+    def events(self, last: Optional[int] = None) -> List[Dict]:
+        """Snapshot of the ring, oldest first (``last`` bounds the
+        tail).  Deque iteration can raise under concurrent append —
+        retry, then index-walk (the tracer's discipline)."""
+        out = None
+        for _ in range(8):
+            try:
+                out = list(self._events)
+                break
+            except RuntimeError:
+                continue
+        if out is None:
+            out = []
+            for i in range(len(self._events)):
+                try:
+                    out.append(self._events[i])
+                except IndexError:
+                    break
+        if last is not None and len(out) > last:
+            out = out[-int(last):]
+        return out
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    # -- bundles -------------------------------------------------------
+    def install_dump(self, directory, host: Optional[str] = None,
+                     registry=None, tracer=None, alerts=None,
+                     persist_interval_s: Optional[float] = None
+                     ) -> "FlightRecorder":
+        """Arm bundle writing: ``directory`` is the shared dir (the
+        checkpoint/beacon dir is the natural choice), ``registry`` /
+        ``tracer`` default to the process-wide ones at dump time,
+        ``alerts`` is an optional :class:`~.slo.AlertEngine` whose
+        state rides in every bundle.  ``persist_interval_s`` starts
+        the black-box daemon (periodic ring snapshots a SIGKILL
+        cannot suppress)."""
+        host = str(host) if host is not None else _default_host_id()
+        if os.sep in host:
+            raise ValueError(f"host {host!r} must be a plain name")
+        interval = (float(persist_interval_s)
+                    if persist_interval_s else None)
+        if interval is not None and interval <= 0:
+            raise ValueError("persist_interval_s must be > 0")
+        with self._lock:
+            self._cfg = {"directory": str(directory), "host": host,
+                         "registry": registry, "tracer": tracer,
+                         "alerts": alerts}
+            alive = (self._thread is not None
+                     and self._thread.is_alive())
+            if interval is not None and alive:
+                # a NEW cadence replaces the running daemon — the
+                # old interval silently sticking (a 50ms chaos-drill
+                # cadence surviving into production) would hammer
+                # the shared dir forever
+                self._stop.set()
+                self._thread = None
+                alive = False
+        if interval is not None and not alive:
+            # a FRESH stop event re-arms after a close()/uninstall
+            # (the old set() event would end the new daemon's first
+            # wait and silently kill the black box); the thread
+            # closes over ITS OWN event, so a concurrent re-arm can
+            # never steal a running loop's stop signal
+            stop = threading.Event()
+            thread = threading.Thread(
+                target=self._persist_loop, args=(interval, stop),
+                name="dl4j-tpu-flightrec", daemon=True)
+            with self._lock:
+                self._stop = stop
+                self._thread = thread
+            thread.start()
+        return self
+
+    def uninstall_dump(self) -> None:
+        """Disarm bundle writing AND stop the black-box daemon —
+        scoped chaos drills and tests must not leave the
+        process-default recorder pointed at a dead directory or a
+        stray daemon spinning against it."""
+        with self._lock:
+            self._cfg = None
+            self._stop.set()
+            self._thread = None
+
+    def _bundle_doc(self, cfg: dict, reason: str) -> dict:
+        registry = cfg.get("registry")
+        tracer = cfg.get("tracer")
+        alerts = cfg.get("alerts")
+        if registry is None or tracer is None:
+            from deeplearning4j_tpu import telemetry
+            registry = registry or telemetry.get_registry()
+            tracer = tracer or telemetry.get_tracer()
+        open_spans = [{"name": sp.name, "ts": sp.ts, "tid": sp.tid,
+                       "bound": sp.bound, "args": dict(sp.args)}
+                      for sp in tracer.open_spans()]
+        doc = {"kind": "postmortem", "reason": str(reason),
+               "host": cfg["host"], "pid": os.getpid(),
+               "t": time.time(), "events": self.events(),
+               "open_spans": open_spans,
+               "metrics": registry.snapshot()}
+        try:
+            doc["slo"] = alerts.state() if alerts is not None else None
+        except Exception:            # a torn engine must not cost the
+            doc["slo"] = None        # bundle its events
+        return doc
+
+    def request_dump(self, reason: str, error: Optional[str] = None
+                     ) -> Optional[str]:
+        """Write one postmortem bundle NOW; returns its path, or None
+        when no dump dir is installed (the hot sites call this
+        unconditionally — unconfigured processes pay a lock peek).
+        Never raises: a postmortem writer that crashes its caller
+        would be the worst bug in the file."""
+        with self._lock:
+            cfg = self._cfg
+        if cfg is None or not self.enabled:
+            return None
+        try:
+            from deeplearning4j_tpu.resilience.coordination import (
+                atomic_publish_json)
+            doc = self._bundle_doc(cfg, reason)
+            if error is not None:
+                doc["error"] = str(error)
+            path = os.path.join(
+                cfg["directory"], BUNDLE_DIRNAME,
+                f"{cfg['host']}-{os.getpid()}-"
+                f"{next(self._dump_seq)}.json")
+            atomic_publish_json(path, doc)
+            if self._bundles is None:
+                from deeplearning4j_tpu import telemetry
+                self._bundles = telemetry.counter(
+                    "postmortem_bundles_total",
+                    "crash-forensics bundles this process published "
+                    "(watchdog trips, chaos kills, preemptions, "
+                    "explicit dumps)")
+            self._bundles.inc()
+            log.warning("flight recorder: postmortem bundle %s (%s)",
+                        path, reason)
+            return path
+        except Exception:
+            log.exception("flight recorder: bundle write failed (%s)",
+                          reason)
+            return None
+
+    # -- black box persistence ----------------------------------------
+    def _persist_once(self) -> Optional[str]:
+        with self._lock:
+            cfg = self._cfg
+        if cfg is None:
+            return None
+        from deeplearning4j_tpu.resilience.coordination import (
+            atomic_publish_json)
+        doc = self._bundle_doc(cfg, "blackbox")
+        path = os.path.join(cfg["directory"], BLACKBOX_DIRNAME,
+                            f"{cfg['host']}.json")
+        atomic_publish_json(path, doc)
+        return path
+
+    def _persist_loop(self, interval: float,
+                      stop: threading.Event) -> None:
+        while not stop.wait(interval):
+            try:
+                self._persist_once()
+            except Exception:        # a shared-dir flake must never
+                log.exception(       # kill the black box for good
+                    "flight recorder: black-box persist failed")
+
+    def close(self) -> None:
+        """Stop the black-box daemon (one final persist included)."""
+        with self._lock:
+            stop = self._stop
+            thread = self._thread
+            self._thread = None
+        stop.set()
+        if thread is not None:
+            thread.join(timeout=5)
+            try:
+                self._persist_once()
+            except Exception:
+                log.exception("flight recorder: final persist failed")
+
+
+def list_bundles(directory) -> List[str]:
+    """Postmortem bundle paths under ``directory``, oldest first."""
+    bdir = os.path.join(str(directory), BUNDLE_DIRNAME)
+    try:
+        names = os.listdir(bdir)
+    except OSError:
+        return []
+    paths = [os.path.join(bdir, n) for n in names
+             if n.endswith(".json")]
+    return sorted(paths, key=lambda p: (os.path.getmtime(p), p))
+
+
+def load_bundle(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def salvage_bundles(directory) -> List[str]:
+    """Promote black-box ring snapshots whose (host, pid) never wrote
+    a real bundle into ``reason="salvaged: ..."`` postmortems — the
+    SIGKILL path: the victim could not dump, but its black-box daemon
+    left the last persisted ring + open spans behind.  Idempotent
+    (an already-salvaged (host, pid) is skipped); returns the NEW
+    bundle paths."""
+    directory = str(directory)
+    from deeplearning4j_tpu.resilience.coordination import (
+        atomic_publish_json)
+    covered = set()
+    for path in list_bundles(directory):
+        try:
+            doc = load_bundle(path)
+            covered.add((doc.get("host"), doc.get("pid")))
+        except (OSError, ValueError):
+            continue
+    bbdir = os.path.join(directory, BLACKBOX_DIRNAME)
+    try:
+        names = sorted(os.listdir(bbdir))
+    except OSError:
+        return []
+    out: List[str] = []
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            doc = load_bundle(os.path.join(bbdir, name))
+        except (OSError, ValueError):
+            continue                 # mid-replace: next pass gets it
+        key = (doc.get("host"), doc.get("pid"))
+        if key in covered:
+            continue
+        doc["reason"] = f"salvaged: {doc.get('reason', 'blackbox')}"
+        doc["salvaged"] = True
+        path = os.path.join(directory, BUNDLE_DIRNAME,
+                            f"{doc.get('host', 'unknown')}-"
+                            f"{doc.get('pid', 0)}-salvaged.json")
+        atomic_publish_json(path, doc)
+        out.append(path)
+    return out

@@ -320,19 +320,40 @@ class FleetTraceStore:
       tree on the next :meth:`tree` call — assembly is pure and
       re-runs per query, so arrival order can never corrupt a trace.
 
-    Bounded: at most ``max_traces`` traces (oldest-insertion evicted)
-    of ``max_spans`` spans each — an aggregator outlives every
-    request it has ever seen."""
+    Bounded THREE ways (an aggregator outlives every request it has
+    ever seen): at most ``max_spans`` spans per trace, at most
+    ``max_traces`` traces total (oldest-insertion evicted), and —
+    ISSUE 15 — at most ``max_retired`` RETIRED traces (a trace whose
+    ``request`` root arrived with a terminal ``outcome`` arg is
+    complete; under sustained traffic these are the unbounded
+    population, and they evict LRU BY RETIRE TIME well before the
+    capacity bound would thrash live traces).  Every eviction counts
+    into ``fleet_trace_store_evicted_total`` on the fleet scrape."""
 
     #: the root-span name ``ServingFleet.submit`` mints
     ROOT = "request"
     #: the local root of a fragment that CONTINUES another host's trace
     HANDOFF = "request/handoff"
 
-    def __init__(self, max_traces: int = 512, max_spans: int = 512):
+    def __init__(self, max_traces: int = 512, max_spans: int = 512,
+                 max_retired: Optional[int] = None):
         self.max_traces = int(max_traces)
         self.max_spans = int(max_spans)
+        # default: half the capacity — retired traces must never be
+        # able to crowd out the live ones the capacity bound protects
+        self.max_retired = (int(max_retired) if max_retired is not None
+                            else max(1, self.max_traces // 2))
+        if not 0 < self.max_retired <= self.max_traces:
+            raise ValueError(
+                f"need 0 < max_retired ({self.max_retired}) <= "
+                f"max_traces ({self.max_traces})")
         self._lock = threading.Lock()
+        # trace -> retire wall time, in RETIREMENT-ARRIVAL order (the
+        # LRU the retention cap evicts from); eviction tally for the
+        # fleet scrape counter
+        self._retired: "collections.OrderedDict[str, float]" = \
+            collections.OrderedDict()
+        self._evicted = 0
         # host -> trace -> {seq}: keyed per trace so evicting a trace
         # prunes its dedup state too — the store stays bounded however
         # long the aggregator lives (an evicted trace's tail still in
@@ -375,15 +396,31 @@ class FleetTraceStore:
                 if spans is None:
                     spans = self._traces[trace] = []
                     while len(self._traces) > self.max_traces:
-                        old, _ = self._traces.popitem(last=False)
-                        for hseen in self._seen.values():
-                            hseen.pop(old, None)
-                        log.debug("FleetTraceStore evicted trace %s",
-                                  old)
+                        old = next(iter(self._traces))
+                        self._evict_locked(old)
                 if len(spans) < self.max_spans:
                     spans.append(dict(ev, host=host))
                     n_new += 1
+                if ev.get("name") == self.ROOT \
+                        and "outcome" in ev.get("args", {}):
+                    # the submit-minted root closed with a terminal
+                    # outcome: the trace is RETIRED — enter (or
+                    # refresh, under duplicate delivery) the
+                    # retention LRU and enforce its cap
+                    self._retired[trace] = float(ev.get("wall", 0.0))
+                    self._retired.move_to_end(trace)
+                    while len(self._retired) > self.max_retired:
+                        old = next(iter(self._retired))
+                        self._evict_locked(old)
         return n_new
+
+    def _evict_locked(self, trace: str) -> None:
+        self._traces.pop(trace, None)
+        self._retired.pop(trace, None)
+        for hseen in self._seen.values():
+            hseen.pop(trace, None)
+        self._evicted += 1
+        log.debug("FleetTraceStore evicted trace %s", trace)
 
     # -- query ---------------------------------------------------------
     def trace_ids(self) -> List[str]:
@@ -403,11 +440,14 @@ class FleetTraceStore:
         for every trace on every scrape."""
         with self._lock:
             traces = {t: list(evs) for t, evs in self._traces.items()}
+            retired = len(self._retired)
+            evicted = self._evicted
         rooted = sum(
             1 for evs in traces.values()
             if any(ev["name"] == self.ROOT for ev in evs))
         return {"traces": len(traces), "rooted": rooted,
-                "spans": sum(len(evs) for evs in traces.values())}
+                "spans": sum(len(evs) for evs in traces.values()),
+                "retired": retired, "evicted": evicted}
 
     def tree(self, trace_id: str) -> Dict:
         """Stitch one trace's fragments into a submit->retire tree.

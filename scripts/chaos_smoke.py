@@ -36,10 +36,12 @@ standalone:
 import importlib.util
 import json
 import os
+import shutil
 import sys
 import tempfile
 import threading
 import time
+import urllib.request
 
 # the pipeline chaos run needs >= 2 devices; force a virtual CPU pair
 # BEFORE jax initializes (no-op in-process under tests/conftest.py,
@@ -543,6 +545,147 @@ def main() -> int:
         problems.append("fleet healthy_replicas != 1 after scale-in")
     fleet2.shutdown(drain=True)
 
+    # -- SLO error-budget closed loop + kill under pressure (ISSUE
+    # 15): induced overload -> the burn-rate alert fires on the
+    # AGGREGATED scrape BEFORE any interactive deadline miss -> the
+    # autoscaler pre-warm is attributed to the ALERT signal
+    # (fleet_autoscale_alert_prewarms_total) -> a replica SIGKILL
+    # mid-storm yields EXACTLY ONE postmortem bundle whose merged
+    # timeline (scripts/postmortem.py) holds the victim's final
+    # dispatch events, its open spans and the alert state. --------
+    from deeplearning4j_tpu.telemetry import flightrec
+    from deeplearning4j_tpu.telemetry.slo import AlertEngine, SLOSpec
+
+    def _load_postmortem():
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "postmortem.py")
+        spec = importlib.util.spec_from_file_location("postmortem",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    alert_prewarms = counter("fleet_autoscale_alert_prewarms_total")
+    apw0 = alert_prewarms.value
+    exp0 = outcome_total("expired")
+    pa = np.asarray([1, 2, 3, 4], np.int32)
+    ref_slo = offline.generate(pa[None], n_new=24)[0]
+    slo_dir = tempfile.mkdtemp(prefix="chaos_slo_")
+    # the queue-phase latency SLO: waits past 0.1s are budget burn —
+    # under the storm they appear SECONDS before any 300s deadline
+    # could possibly miss, so the alert firing IS the early signal
+    slo_eng = AlertEngine(
+        [SLOSpec("inter-latency", objective="latency", target=0.9,
+                 phase="queue", threshold_s=0.1, window_s=600.0,
+                 windows=[(0.4, 1.2, 1.5, "page")])])
+    recorder = telemetry.get_flight_recorder()
+    recorder.install_dump(slo_dir, host="chaos", alerts=slo_eng)
+    fleet3 = ServingFleet(gpt, n_replicas=1, n_slots=2, max_len=32,
+                          block_size=4, tick_batch=1,
+                          tick_timeout_s=None)
+    # reactive targets deliberately untrippable (30s wait target, no
+    # depth ceiling, no forecaster): ONLY the burn-rate alert can
+    # drive the scale-up, so the pre-warm attribution is airtight
+    pol3 = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                           queue_wait_p99_target_s=30.0,
+                           up_consecutive=2, down_consecutive=1000,
+                           cooldown_s=0.3)
+    scaler3 = Autoscaler(fleet3, pol3, interval_s=0.05,
+                         alert_engine=slo_eng).start()
+    try:
+        # enough backlog that the storm outlasts the engine's 1.2s
+        # long-window coverage on a fast box
+        hs3 = [fleet3.submit_async(pa, n_new=24, tenant="inter",
+                                   deadline_s=300.0)
+               for _ in range(64)]
+        fire_by = time.monotonic() + 120
+        while time.monotonic() < fire_by:
+            if alert_prewarms.value - apw0 >= 1:
+                break
+            time.sleep(0.02)
+        if alert_prewarms.value - apw0 < 1:
+            problems.append(
+                "induced overload produced no ALERT-attributed "
+                f"pre-warm (alerts: {slo_eng.alerts()})")
+        if outcome_total("expired") - exp0 != 0:
+            problems.append("an interactive deadline miss preceded "
+                            "the burn-rate alert pre-warm")
+        if all(h.done() for h in hs3):
+            problems.append("storm drained before the kill — no "
+                            "in-flight forensics to freeze")
+        # SIGKILL the storm's original replica mid-decode,
+        # IMMEDIATELY after the pre-warm: the kill freezes the black
+        # box while its requests' spans are still open, then
+        # everything migrates to the pre-warmed replica
+        fleet3.kill(0)
+        # the alert's lifecycle must be observable on the AGGREGATED
+        # scrape (the engine's families beacon like any other; the
+        # transitions counter is monotonic, so the observation is
+        # race-free even after the burn resolves)
+        telemetry.publish_beacon(slo_dir, "chaos", registry=registry)
+        fr3 = telemetry.FleetRegistry(slo_dir, stale_after_s=3600.0)
+        with telemetry.start_metrics_server(fr3, port=0) as srv3:
+            agg_body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv3.port}/metrics",
+                timeout=5).read().decode()
+        for needle in ('fleet_slo_alert_transitions_total'
+                       '{slo="inter-latency",to="firing",'
+                       'host="chaos"}',
+                       'fleet_slo_alert_firing{slo="inter-latency",'
+                       'host="chaos"}',
+                       'fleet_autoscale_alert_prewarms_total'
+                       '{host="chaos"}'):
+            if needle not in agg_body:
+                problems.append(f"aggregated scrape missing {needle}")
+        for i, h in enumerate(hs3):
+            try:
+                if not np.array_equal(h.result(timeout=300), ref_slo):
+                    problems.append(f"slo-storm output {i} mismatch "
+                                    "after the kill")
+            except Exception as e:
+                problems.append(f"slo-storm request {i} failed after "
+                                f"the kill: {e}")
+    finally:
+        scaler3.close()
+        fleet3.shutdown(drain=True)
+        recorder.uninstall_dump()
+    if outcome_total("expired") - exp0 != 0:
+        problems.append("interactive deadline misses during the SLO "
+                        "kill storm")
+    bundles = flightrec.list_bundles(slo_dir)
+    if len(bundles) != 1:
+        problems.append(f"expected exactly 1 postmortem bundle, "
+                        f"found {len(bundles)}")
+    else:
+        # merged timeline: the victim's final dispatch events, its
+        # open spans at the kill, and the alert state — stitched
+        # against the beaconed trace store
+        telemetry.publish_beacon(
+            slo_dir, "chaos", registry=registry,
+            trace_events=telemetry.get_tracer().trace_events())
+        pm = _load_postmortem()
+        bdoc = flightrec.load_bundle(bundles[0])
+        entries = pm.merge_timeline(bdoc,
+                                    pm.build_trace_store(slo_dir))
+        if bdoc.get("reason") != "chaos_kill: replica 0":
+            problems.append(f"bundle reason {bdoc.get('reason')!r}")
+        if not any(e["src"] == "event" and e["what"] == "dispatch"
+                   and "replica=0" in e["detail"] for e in entries):
+            problems.append("postmortem timeline lost the victim's "
+                            "final dispatch events")
+        if not any(e["src"] == "open" for e in entries):
+            problems.append("postmortem timeline holds no open spans "
+                            "(the in-flight work at the kill)")
+        if not any(e["src"] == "alert"
+                   and e["what"] == "slo:inter-latency"
+                   for e in entries):
+            problems.append("postmortem timeline lost the alert "
+                            "state")
+        if not any(e["src"] == "span" for e in entries):
+            problems.append("postmortem timeline stitched no trace-"
+                            "store spans")
+    shutil.rmtree(slo_dir, ignore_errors=True)
+
     # -- sanitizer: one deliberate nan trip so the series has a
     # labeled child on the wire (check_finite itself is unconditional
     # — DL4J_TPU_SANITIZE gates the CALL SITES, not the check) -------
@@ -594,7 +737,11 @@ def main() -> int:
                    'fleet_autoscale_actions_total{direction="down"}',
                    # the predictive pre-warm that beat the reactive
                    # signals to the scale-up (ISSUE 13)
-                   "fleet_autoscale_prewarms_total"):
+                   "fleet_autoscale_prewarms_total",
+                   # the ALERT-attributed pre-warm + the bundle the
+                   # SLO kill storm published (ISSUE 15)
+                   "fleet_autoscale_alert_prewarms_total",
+                   "postmortem_bundles_total"):
         for line in body.splitlines():
             if line.startswith(needle + " "):
                 if float(line.rsplit(" ", 1)[1]) <= 0:
@@ -634,6 +781,16 @@ def main() -> int:
         'fleet_autoscale_forecast{signal="breach_s"}',
         'fleet_device_phase_seconds_bucket{device="cpu:0",'
         'phase="optimizer_step"',
+        # ISSUE 15: the burn-rate alert's lifecycle on the wire, and
+        # the flight-recorder events the scenarios fed
+        'fleet_slo_alert_transitions_total{slo="inter-latency",'
+        'to="firing"}',
+        'fleet_slo_alert_firing{slo="inter-latency"}',
+        'fleet_slo_error_budget_remaining{slo="inter-latency"}',
+        'flight_events_total{kind="dispatch"}',
+        'flight_events_total{kind="chaos_kill"}',
+        'flight_events_total{kind="scale"}',
+        'flight_events_total{kind="watchdog"}',
     ]
     problems += ct.missing_series(body, required)
 

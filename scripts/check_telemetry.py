@@ -24,6 +24,7 @@ import os
 import sys
 import tempfile
 import threading
+import time
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -175,6 +176,36 @@ FLEET_OBS_SERIES = [
     "fleet_trace_store_traces",
     "fleet_trace_store_spans",
     "fleet_trace_store_rooted",
+]
+
+# SLO error-budget engine (ISSUE 15): the induced-burn smoke below
+# drives a synthetic outcome stream through a REAL AlertEngine
+# attached to a FleetRegistry and scrapes the aggregated endpoint —
+# the alert is observed FIRING on the wire (gauge 1.0 + the
+# transitions counter), then RESOLVING once the bleeding stops.
+# Asserted against the mid-burn FLEET scrape body, not the process
+# registry (the engine exports into the aggregated view).
+SLO_SERIES = [
+    'fleet_slo_burn_rate{slo="smoke-avail",window="0.1s",'
+    'host="fleet"}',
+    'fleet_slo_burn_rate{slo="smoke-avail",window="0.3s",'
+    'host="fleet"}',
+    'fleet_slo_error_budget_remaining{slo="smoke-avail",'
+    'host="fleet"}',
+    'fleet_slo_alert_state{slo="smoke-avail",host="fleet"}',
+    'fleet_slo_alert_firing{slo="smoke-avail",host="fleet"} 1.0',
+    'fleet_slo_alert_transitions_total{slo="smoke-avail",'
+    'to="firing",host="fleet"} 1',
+]
+
+# Flight recorder (ISSUE 15): the serve smokes above feed the
+# process-default ring (admit/retire events), and the SLO section
+# writes one explicit postmortem bundle — both families carry live
+# values on the MAIN scrape.
+FLIGHT_SERIES = [
+    'flight_events_total{kind="admit"}',
+    'flight_events_total{kind="retire"}',
+    "postmortem_bundles_total",
 ]
 
 # Predictive-autoscaling series (ISSUE 13): the forecaster below runs
@@ -617,6 +648,70 @@ def main() -> int:
         problems.append("world=2 checkpoint fleet-resumed at world=1 "
                         "counted no elastic shrink")
 
+    # -- SLO error-budget engine (ISSUE 15): an induced burn must be
+    # observed FIRING on a real aggregated scrape, then RESOLVING
+    # once the bleeding stops; one explicit postmortem bundle proves
+    # the flight-recorder dump path end to end --------------------
+    from deeplearning4j_tpu.telemetry.slo import AlertEngine, SLOSpec
+    sreg = telemetry.MetricsRegistry()
+    sfam = sreg.counter("fleet_requests_total",
+                        labelnames=("tenant", "outcome"))
+    sfam.labels(tenant="smoke", outcome="admitted")
+    sfam.labels(tenant="smoke", outcome="failed")
+    slo_eng = AlertEngine(
+        [SLOSpec("smoke-avail", target=0.9, window_s=600.0,
+                 windows=[(0.1, 0.3, 1.5, "page")])],
+        registry=telemetry.MetricsRegistry())
+    with tempfile.TemporaryDirectory() as d:
+        telemetry.publish_beacon(d, "slohost", registry=sreg)
+        fview = telemetry.FleetRegistry(d, stale_after_s=3600.0,
+                                        alerts=slo_eng)
+        with telemetry.start_metrics_server(fview, port=0) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            urllib.request.urlopen(base + "/metrics",
+                                   timeout=5).read()   # primes
+            sfam.labels(tenant="smoke", outcome="failed").inc(9)
+            sfam.labels(tenant="smoke", outcome="admitted").inc(1)
+            telemetry.publish_beacon(d, "slohost", registry=sreg)
+            time.sleep(0.35)           # long-window coverage accrues
+            slo_body = urllib.request.urlopen(
+                base + "/metrics", timeout=5).read().decode()
+            alerts_doc = json.loads(urllib.request.urlopen(
+                base + "/alerts", timeout=5).read().decode())
+            problems += missing_series(slo_body, SLO_SERIES)
+            if alerts_doc.get("firing") != ["smoke-avail"]:
+                problems.append("induced burn not firing at /alerts: "
+                                f"{alerts_doc.get('firing')}")
+            # the bleeding stops: clean traffic must RESOLVE it
+            sfam.labels(tenant="smoke", outcome="admitted").inc(500)
+            telemetry.publish_beacon(d, "slohost", registry=sreg)
+            time.sleep(0.35)
+            alerts_doc = json.loads(urllib.request.urlopen(
+                base + "/alerts", timeout=5).read().decode())
+            states = {a["slo"]: a["state"]
+                      for a in alerts_doc.get("alerts", ())}
+            if states.get("smoke-avail") != "resolved":
+                problems.append("induced burn did not resolve after "
+                                f"clean traffic: {states}")
+        # one explicit postmortem bundle: the dump path end to end
+        recorder = telemetry.get_flight_recorder()
+        recorder.install_dump(d, host="smokehost", alerts=slo_eng)
+        bundle_path = recorder.request_dump("check_telemetry smoke")
+        recorder.uninstall_dump()
+        from deeplearning4j_tpu.telemetry import flightrec
+        if bundle_path is None or flightrec.list_bundles(d) != [
+                bundle_path]:
+            problems.append("explicit request_dump produced no "
+                            "postmortem bundle")
+        else:
+            bdoc = flightrec.load_bundle(bundle_path)
+            if not bdoc.get("events"):
+                problems.append("postmortem bundle carries no "
+                                "flight-recorder events")
+            if (bdoc.get("slo") or {}).get("specs") != 1:
+                problems.append("postmortem bundle carries no SLO "
+                                "state")
+
     # -- static analysis: lint series on the wire ----------------------
     emit_analysis_series(problems)
 
@@ -663,7 +758,7 @@ def main() -> int:
         "fleet_xprof_capture_files",
     ] + PAGED_KV_SERIES + TIERED_KV_SERIES + SPEC_SERIES \
       + FLEET_SERIES + RESILIENCE_SERIES + ANALYSIS_SERIES \
-      + FORECAST_SERIES
+      + FORECAST_SERIES + FLIGHT_SERIES
     problems += missing_series(body, required)
     if lat.count - lat_before != 16:
         problems.append(

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Postmortem renderer — one merged timeline per crash bundle.
+
+A postmortem bundle (``telemetry.flightrec``) freezes a host's last-N
+flight-recorder events, the tracer's still-open spans, a final metric
+snapshot and the SLO/alert state.  The fleet's trace store holds the
+OTHER half of the story: the victim's requests' closed spans, beaconed
+before the crash and stitched across hosts.  This script merges both
+into ONE wall-clock timeline:
+
+    python scripts/postmortem.py <shared_dir>                 # latest
+    python scripts/postmortem.py <shared_dir> --bundle NAME
+    python scripts/postmortem.py <shared_dir> --salvage       # promote
+        # black-box ring snapshots of SIGKILL'd hosts into bundles
+    python scripts/postmortem.py <shared_dir> --json          # machine
+
+The text rendering is ordered by wall clock with one source tag per
+line (``event`` = flight-recorder ring, ``span`` = stitched trace
+store, ``open`` = spans still open at the crash, ``alert`` = SLO
+state), so "what was this replica doing when it died" reads top to
+bottom.  Importable: ``merge_timeline(bundle, trace_store)`` /
+``render_timeline(entries)`` are what ``tests/test_slo.py`` and the
+chaos smoke assert against.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.telemetry import flightrec  # noqa: E402
+
+
+def _fmt_fields(d: dict, skip=("seq", "wall", "ts", "kind")) -> str:
+    return " ".join(f"{k}={v}" for k, v in d.items()
+                    if k not in skip and v is not None)
+
+
+def _flatten_tree(node, out, depth=0):
+    out.append({"wall": float(node.get("wall", 0.0)), "src": "span",
+                "what": node["name"], "host": node.get("host"),
+                "depth": depth,
+                "detail": _fmt_fields(
+                    dict(node.get("args", {}),
+                         dur_ms=round(node.get("dur", 0.0) / 1e3, 3)))})
+    for child in node.get("children", ()):
+        _flatten_tree(child, out, depth + 1)
+
+
+def merge_timeline(bundle: dict, trace_store=None) -> list:
+    """Merge one bundle with the trace store's stitched trees into a
+    wall-clock-sorted entry list.  Only traces the bundle's OWN
+    events reference are pulled from the store — a fleet aggregator
+    holds every request; the postmortem wants the victim's."""
+    entries = []
+    for ev in bundle.get("events", ()):
+        entries.append({"wall": float(ev.get("wall", 0.0)),
+                        "src": "event", "what": ev.get("kind", "?"),
+                        "host": bundle.get("host"), "depth": 0,
+                        "detail": _fmt_fields(ev)})
+    t_crash = float(bundle.get("t", 0.0))
+    for sp in bundle.get("open_spans", ()):
+        entries.append({"wall": t_crash, "src": "open",
+                        "what": sp.get("name", "?"),
+                        "host": bundle.get("host"), "depth": 0,
+                        "detail": _fmt_fields(
+                            dict(sp.get("args", {}),
+                                 still_open_at_crash=True))})
+    slo = bundle.get("slo") or {}
+    for alert in slo.get("alerts", ()):
+        if alert.get("state") == "inactive":
+            continue
+        entries.append({
+            "wall": float(alert.get("t_fired") or t_crash),
+            "src": "alert", "what": f"slo:{alert['slo']}",
+            "host": bundle.get("host"), "depth": 0,
+            "detail": (f"state={alert['state']} "
+                       f"budget_remaining="
+                       f"{alert['budget_remaining']:.3g} "
+                       f"burns={alert['burns']}")})
+    if trace_store is not None:
+        traces = sorted({ev.get("trace")
+                         for ev in bundle.get("events", ())
+                         if ev.get("trace")})
+        for tid in traces:
+            tree = trace_store.tree(tid)
+            if tree.get("root"):
+                _flatten_tree(tree["root"], entries)
+            for orphan in tree.get("orphans", ()):
+                _flatten_tree(orphan, entries)
+    entries.sort(key=lambda e: (e["wall"], e["src"], e["what"]))
+    return entries
+
+
+def render_timeline(entries, reason: str = "") -> str:
+    lines = [f"postmortem timeline ({len(entries)} entries)"
+             + (f" — {reason}" if reason else "")]
+    for e in entries:
+        ts = time.strftime("%H:%M:%S", time.localtime(e["wall"]))
+        frac = f"{e['wall'] % 1:.3f}"[1:]
+        pad = "  " * e.get("depth", 0)
+        lines.append(f"{ts}{frac} [{e['src']:>5}] {pad}{e['what']}"
+                     + (f" ({e['host']})" if e.get("host") else "")
+                     + (f" {e['detail']}" if e.get("detail") else ""))
+    return "\n".join(lines)
+
+
+def build_trace_store(shared_dir: str):
+    """The aggregator's view of the shared dir's beacons (None when
+    no beacon directory exists — the bundle still renders alone)."""
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.telemetry.fleet import BEACON_DIRNAME
+    if not os.path.isdir(os.path.join(shared_dir, BEACON_DIRNAME)):
+        return None
+    fr = telemetry.FleetRegistry(shared_dir, stale_after_s=float("inf"))
+    fr.refresh()
+    return fr.traces
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("shared_dir", help="the fleet's shared directory "
+                    "(beacons + _postmortem bundles)")
+    ap.add_argument("--bundle", default="latest",
+                    help="bundle file name (or 'latest')")
+    ap.add_argument("--salvage", action="store_true",
+                    help="promote SIGKILL'd hosts' black-box ring "
+                    "snapshots into bundles first")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the merged timeline as JSON")
+    args = ap.parse_args(argv)
+
+    if args.salvage:
+        for path in flightrec.salvage_bundles(args.shared_dir):
+            print(f"salvaged: {path}", file=sys.stderr)
+    bundles = flightrec.list_bundles(args.shared_dir)
+    if not bundles:
+        print(json.dumps({"ok": False,
+                          "error": "no postmortem bundles found"}))
+        return 1
+    if args.bundle == "latest":
+        path = bundles[-1]
+    else:
+        match = [p for p in bundles
+                 if os.path.basename(p) == args.bundle]
+        if not match:
+            print(json.dumps({
+                "ok": False,
+                "error": f"bundle {args.bundle!r} not found",
+                "bundles": [os.path.basename(p) for p in bundles]}))
+            return 1
+        path = match[0]
+    bundle = flightrec.load_bundle(path)
+    entries = merge_timeline(bundle, build_trace_store(args.shared_dir))
+    if args.as_json:
+        print(json.dumps({"ok": True, "bundle": os.path.basename(path),
+                          "reason": bundle.get("reason"),
+                          "host": bundle.get("host"),
+                          "entries": entries}))
+    else:
+        print(render_timeline(entries, bundle.get("reason", "")))
+        print(f"\nbundle: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

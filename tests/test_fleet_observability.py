@@ -327,7 +327,10 @@ def test_trace_store_duplicate_delivery_is_idempotent():
 
 
 def test_trace_store_ignores_untraced_events_and_bounds_traces():
-    st = FleetTraceStore(max_traces=2)
+    # max_retired pinned to the capacity (ISSUE 15 defaults it to
+    # half): this test is about the CAPACITY bound; the retired-
+    # retention LRU has its own matrix in tests/test_slo.py
+    st = FleetTraceStore(max_traces=2, max_retired=2)
     tr = SpanTracer()
     with tr.span("serve/tick", k=4):
         pass                           # no trace arg: host-local
@@ -337,6 +340,7 @@ def test_trace_store_ignores_untraced_events_and_bounds_traces():
                                           root="request"))
     assert len(st.trace_ids()) == 2    # oldest evicted
     assert "t-0" not in st.trace_ids()
+    assert st.summary()["evicted"] == 1
 
 
 def test_owner_death_flushed_spans_reach_the_beacon_stream(tmp_path):

@@ -1,0 +1,51 @@
+"""Flight-recorder SIGKILL victim (ISSUE 15).
+
+Runs a tiny ``GenerationServer`` with the flight recorder's BLACK-BOX
+persistence armed (periodic ring + open-span snapshots into the
+shared dir), admits one slow decode (every scheduler pass throttled
+by a ``serve_tick_stall`` plan so the request stays mid-decode for
+seconds), and then spins — waiting to be SIGKILL'd by the parent
+test.  A SIGKILL runs no handlers, so the ONLY forensic record is
+what the black-box daemon persisted; the parent salvages it into a
+postmortem bundle and asserts the victim's last events (admit) and
+its still-open spans (request/decode) survived the kill.
+
+Usage: flightrec_worker.py <shared_dir>
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+shared_dir = sys.argv[1]
+
+from deeplearning4j_tpu import telemetry  # noqa: E402
+from deeplearning4j_tpu.parallel import GenerationServer  # noqa: E402
+from deeplearning4j_tpu.resilience import FaultInjector  # noqa: E402
+from deeplearning4j_tpu.resilience.faults import (  # noqa: E402
+    throttled_stall_plan)
+from deeplearning4j_tpu.zoo.gpt import Gpt  # noqa: E402
+
+host = f"victim-{os.getpid()}"
+telemetry.get_flight_recorder().install_dump(
+    shared_dir, host=host, persist_interval_s=0.05)
+
+gpt = Gpt(vocab_size=50, max_len=64, d_model=32, n_layers=2, n_heads=4,
+          d_ff=64, seq_len=8, compute_dtype=None, seed=3).init_graph()
+# tick_batch=1 + a long throttle plan: every scheduler pass stalls
+# 50ms, so the 40-token decode stays in flight for ~2s — plenty of
+# black-box snapshots holding the open decode span before the kill
+with FaultInjector(throttled_stall_plan(
+        2000, "serve_tick_stall@2001:0.05", enqueue_s=0.05)):
+    with GenerationServer(gpt, n_slots=2, max_len=64, tick_batch=1,
+                          tick_timeout_s=None) as srv:
+        h = srv.submit_async(np.asarray([1, 2, 3, 4], np.int32),
+                             n_new=40)
+        # the parent SIGKILLs us mid-decode; result() never returns
+        h.result(timeout=600)
+print("UNEXPECTED: decode finished before the kill", flush=True)
