@@ -59,7 +59,6 @@ Telemetry: ``fleet_autoscale_actions_total{direction=}``,
 """
 from __future__ import annotations
 
-import collections
 import logging
 import math
 import threading
@@ -67,6 +66,9 @@ import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry.tsdb import (TimeSeriesStore,
+                                               is_reset,
+                                               window_quantile)
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -179,31 +181,10 @@ class AutoscalePolicy:
                 "backlog ceiling the forecast projects against")
 
 
-def _window_quantile(uppers: Tuple[float, ...], counts: List[float],
-                     q: float) -> float:
-    """Interpolated quantile over one WINDOW's bucket counts (the
-    registry's ``percentile`` over deltas instead of cumulative
-    state).  ``counts`` includes the trailing +Inf bucket: overflow
-    samples COUNT toward the rank and resolve to the top finite bound
-    — exactly like ``_HistogramChild.percentile`` — because the worst
-    waits are precisely the ones the autoscaler must not lose (an
-    all-overflow meltdown window must read as maximal pressure, not
-    as idle).  NaN when the window is empty."""
-    total = sum(counts)
-    if total <= 0:
-        return math.nan
-    rank = q * total
-    cum = 0.0
-    lo = 0.0
-    for i, ub in enumerate(uppers):
-        prev = cum
-        cum += counts[i]
-        if cum >= rank:
-            if counts[i] == 0:
-                return ub
-            return lo + (rank - prev) / counts[i] * (ub - lo)
-        lo = ub
-    return uppers[-1] if uppers else math.nan
+# the windowed-bucket quantile moved to the shared history substrate
+# (ISSUE 16) — ``telemetry.tsdb.window_quantile`` is the one encoding;
+# the alias keeps this module's historical import surface working
+_window_quantile = window_quantile
 
 
 def fit_trend(points: Iterable[Tuple[float, float]]
@@ -257,29 +238,36 @@ class BacklogForecaster:
     carries — ``fleet_queue_depth``); ``breach_s`` fits the window
     and publishes the prediction to the ``fleet_autoscale_forecast``
     gauge family so the forecast is as observable as the signals it
-    predicts.  The shared window mutates only under ``self._lock`` —
-    ``observe``/``breach_s`` may be driven from the autoscaler thread
-    while tests and dashboards read concurrently."""
+    predicts.  The window lives in a
+    :class:`~deeplearning4j_tpu.telemetry.tsdb.TimeSeriesStore`
+    (ISSUE 16 — the shared history substrate, its lock): ``observe``
+    may be driven from the autoscaler thread while tests and
+    dashboards read concurrently."""
 
-    def __init__(self, window_s: float = 10.0, min_points: int = 4):
+    _SERIES = "autoscale_backlog"
+
+    def __init__(self, window_s: float = 10.0, min_points: int = 4,
+                 store: Optional[TimeSeriesStore] = None):
         self.window_s = float(window_s)
         self.min_points = max(2, int(min_points))
-        self._lock = threading.Lock()
-        self._pts: "collections.deque" = collections.deque()
+        self.store = store if store is not None else TimeSeriesStore()
 
     def observe(self, now: float, backlog: float) -> None:
-        now = float(now)
-        with self._lock:
-            self._pts.append((now, float(backlog)))
-            while self._pts and self._pts[0][0] < now - self.window_s:
-                self._pts.popleft()
+        # mode="window" strict-trims past window_s at append — the
+        # deque this class used to carry, shared now
+        self.store.append(self._SERIES, float(now), float(backlog),
+                          kind="gauge", mode="window",
+                          horizon_s=self.window_s)
+
+    def points(self) -> List[Tuple[float, float]]:
+        """The current fit window, oldest first."""
+        return self.store.points(self._SERIES)
 
     def breach_s(self, threshold: float) -> Optional[float]:
         """Projected seconds until ``threshold``; None when the window
         is too thin or the trend projects no breach.  Publishes the
         slope/backlog/breach_s gauges either way."""
-        with self._lock:
-            pts = list(self._pts)
+        pts = self.points()
         if len(pts) < self.min_points:
             return None
         fit = fit_trend(pts)
@@ -344,10 +332,16 @@ class Autoscaler:
         self._down_streak = 0
         self._last_action = float("-inf")
         self._deferred = False         # defer fired since pressure rose
-        self._hist_prev: Dict[str, Tuple[List[float], float]] = {}
+        # windowed-signal history (ISSUE 16): the per-key cumulative
+        # bucket samples the sliding-window quantiles difference live
+        # in ONE private TimeSeriesStore (pairwise mode — the
+        # prev-snapshot dict this class used to carry), shared with
+        # the forecaster so the loop has a single history substrate
+        self._hist = TimeSeriesStore()
         self._forecaster = (
             BacklogForecaster(self.policy.forecast_window_s,
-                              self.policy.forecast_min_points)
+                              self.policy.forecast_min_points,
+                              store=self._hist)
             if self.policy.forecast_horizon_s is not None else None)
         _TARGET.set(self._target)
 
@@ -411,22 +405,34 @@ class Autoscaler:
         if merged is None:
             return None
         total = sum(merged)
-        key = key or name
-        with self._lock:
-            prev = self._hist_prev.get(key)
-            self._hist_prev[key] = (list(merged), total)
-        if prev is None or total < prev[1]:
-            # first sight (fresh autoscaler on a long-lived registry)
-            # or a registry reset: PRIME the window and report no
-            # signal — reading the whole cumulative history as one
-            # window would resurrect every historical spike as
-            # current pressure, the exact failure windowing exists
-            # to avoid
+        key = "hist_window:" + (key or name)
+        # pairwise window in the shared store: keep the newest two
+        # cumulative samples, difference them (mode="window",
+        # max_points=2 — the prev-snapshot dict this method used to
+        # carry, one reset/windowing encoding with the SLO engine)
+        self._hist.append(key, time.monotonic(),
+                          (tuple(merged), total), kind="window",
+                          mode="window", max_points=2)
+        two = self._hist.last_two(key)
+        if two is None:
+            # first sight (fresh autoscaler on a long-lived registry):
+            # PRIME the window and report no signal — reading the
+            # whole cumulative history as one window would resurrect
+            # every historical spike as current pressure, the exact
+            # failure windowing exists to avoid
             return None
-        window = [max(0.0, c - p) for c, p in zip(merged, prev[0])]
+        (_tp, (prev_counts, prev_total)), _cur = two
+        if is_reset(prev_total, total):
+            # registry reset: re-prime against the fresh epoch
+            self._hist.clear(key)
+            self._hist.append(key, time.monotonic(),
+                              (tuple(merged), total), kind="window",
+                              mode="window", max_points=2)
+            return None
+        window = [max(0.0, c - p) for c, p in zip(merged, prev_counts)]
         if sum(window) <= 0:
             return None
-        return _window_quantile(uppers, window, q)
+        return window_quantile(uppers, window, q)
 
     def interactive_tenants(self, reg) -> Optional[List[str]]:
         """Tenants NOT classed batch (None = no filter: every tenant

@@ -32,9 +32,16 @@ pieces, all stdlib-only:
 * ``flightrec`` — the per-host black box (ISSUE 15): a lock-cheap
   bounded ring of admission/dispatch/spill/watchdog/scale events;
   watchdog trips, chaos kills and preemptions freeze it — with the
-  tracer's open spans, a metric snapshot and the SLO state — into
-  atomic postmortem bundles ``scripts/postmortem.py`` renders as a
-  merged timeline.
+  tracer's open spans, a metric snapshot, pre-crash metric HISTORY
+  and the SLO state — into atomic postmortem bundles
+  ``scripts/postmortem.py`` renders as a merged timeline;
+* ``tsdb``      — the embedded time-series store (ISSUE 16): bounded
+  per-series history rings (raw window + downsampled older tier)
+  recorded each scrape/beacon cycle, range reads with
+  ``rate``/``delta``/``quantile_over_time``, the ``/query`` endpoint
+  beside ``/metrics``/``/traces``/``/alerts`` — and the ONE history
+  substrate the SLO engine, the backlog forecaster and the
+  autoscaler's windowed signals all read through.
 
 Instrumented in-tree: ``optimize.fit_loop`` (step/data-wait split,
 iteration/epoch/example counters), ``parallel.trainer`` and
@@ -66,12 +73,15 @@ from deeplearning4j_tpu.telemetry.fleet import (
     FleetRegistry, MetricsBeacon, exchange_snapshots, publish_beacon)
 from deeplearning4j_tpu.telemetry.profiling import DeviceProfiler
 from deeplearning4j_tpu.telemetry.flightrec import FlightRecorder
-from deeplearning4j_tpu.telemetry.slo import AlertEngine, SLOSpec
+from deeplearning4j_tpu.telemetry.slo import (AlertEngine, CommandSink,
+                                              SLOSpec, WebhookFileSink)
+from deeplearning4j_tpu.telemetry.tsdb import TimeSeriesStore
 
 _REGISTRY = MetricsRegistry()
 _TRACER = SpanTracer()
 _PROFILER = DeviceProfiler(_REGISTRY)
 _FLIGHTREC = FlightRecorder()
+_TSDB = TimeSeriesStore()
 
 
 def get_registry() -> MetricsRegistry:
@@ -95,6 +105,13 @@ def get_flight_recorder() -> FlightRecorder:
     of admission/dispatch/spill/watchdog/scale events the hot sites
     feed, and the postmortem-bundle writer the crash paths trip."""
     return _FLIGHTREC
+
+
+def get_tsdb() -> TimeSeriesStore:
+    """The process-wide embedded time-series store (ISSUE 16):
+    recorded per scrape/beacon cycle, queried at ``/query``, and the
+    pre-crash history source for postmortem bundles."""
+    return _TSDB
 
 
 def counter(name: str, documentation: str = "",
@@ -123,8 +140,9 @@ __all__ = [
     "Span", "MetricsServer", "start_metrics_server", "TelemetryListener",
     "FleetRegistry", "FleetTraceStore", "MetricsBeacon", "publish_beacon",
     "exchange_snapshots", "parse_series", "DeviceProfiler",
-    "FlightRecorder", "AlertEngine", "SLOSpec",
+    "FlightRecorder", "AlertEngine", "SLOSpec", "WebhookFileSink",
+    "CommandSink", "TimeSeriesStore",
     "DEFAULT_BUCKETS", "RATIO_BUCKETS",
     "get_registry", "get_tracer", "get_profiler", "get_flight_recorder",
-    "counter", "gauge", "histogram", "span",
+    "get_tsdb", "counter", "gauge", "histogram", "span",
 ]

@@ -14,7 +14,12 @@ trace store exists for.  When it carries an SLO alert engine
 (``FleetRegistry(alerts=...)`` or a plain registry with an
 ``.alerts`` attribute — ISSUE 15), ``/alerts`` serves the engine's
 state (burn rates, budgets, firing alerts) as JSON, evaluated against
-the served view per request like a scrape.
+the served view per request like a scrape.  When it carries an
+embedded time-series store (every ``FleetRegistry``, or a plain
+registry with a ``.tsdb`` attribute — ISSUE 16), ``/query`` answers
+range reads: ``?series=<name>`` plus optional label matchers
+(``tenant=inter``), ``start``/``end`` (unix seconds),
+``func=range|rate|delta|quantile`` and ``q`` for quantiles.
 
 Error discipline (ISSUE 15): unknown paths answer a REAL 404 with a
 JSON body naming the endpoints, malformed queries answer 400 with a
@@ -101,7 +106,10 @@ class MetricsServer:
                 path = parsed.path
                 traces = getattr(registry, "traces", None)
                 alerts = getattr(registry, "alerts", None)
-                if path == "/traces" and traces is not None:
+                tsdb = getattr(registry, "tsdb", None)
+                if path == "/query" and tsdb is not None:
+                    self._query(tsdb, parsed)
+                elif path == "/traces" and traces is not None:
                     q = urllib.parse.parse_qs(
                         parsed.query, keep_blank_values=True)
                     unknown = sorted(set(q) - {"id"})
@@ -146,8 +154,73 @@ class MetricsServer:
                         endpoints.append("/traces")
                     if alerts is not None:
                         endpoints.append("/alerts")
+                    if tsdb is not None:
+                        endpoints.append("/query")
                     self._send_json(404, {"error": "not_found",
                                           "endpoints": endpoints})
+
+            _QUERY_USAGE = ("/query?series=<name>[&<label>=<value>...]"
+                            "[&start=<unix_s>][&end=<unix_s>]"
+                            "[&func=range|rate|delta|quantile]"
+                            "[&q=<0..1>]")
+
+            def _query(self, tsdb, parsed) -> None:
+                """The TSDB range-read endpoint (ISSUE 16): reserved
+                parameters select/shape the read, every OTHER
+                parameter is a label equality matcher.  Malformed
+                input answers 400 with a JSON error, matching the
+                /traces discipline; an unknown series matches nothing
+                and answers 200 with an empty result."""
+                q = urllib.parse.parse_qs(parsed.query,
+                                          keep_blank_values=True)
+                bad = None
+                repeated = sorted(k for k, v in q.items()
+                                  if len(v) > 1)
+                series = q.get("series", [""])[0]
+                if repeated:
+                    bad = f"repeated parameter(s) {repeated}"
+                elif not series:
+                    bad = ("series must be given exactly once with a "
+                           "non-empty value")
+                start = end = qq = None
+                func = q.get("func", ["range"])[0]
+                if bad is None:
+                    try:
+                        if "start" in q:
+                            start = float(q["start"][0])
+                        if "end" in q:
+                            end = float(q["end"][0])
+                        if "q" in q:
+                            qq = float(q["q"][0])
+                    except ValueError:
+                        bad = "start/end/q must be numbers"
+                if bad is not None:
+                    self._send_json(400, {"error": "bad_query",
+                                          "detail": bad,
+                                          "usage": self._QUERY_USAGE})
+                    return
+                matchers = [(k, v[0]) for k, v in sorted(q.items())
+                            if k not in ("series", "start", "end",
+                                         "func", "q")]
+                # a fleet view refreshes + records a fresh sample per
+                # query, exactly like a scrape drives /alerts
+                view = getattr(registry, "view", None)
+                if callable(view):
+                    self._refresh()
+                    view()
+                try:
+                    doc = tsdb.query(series, matchers=matchers,
+                                     start=start, end=end, func=func,
+                                     q=qq)
+                except ValueError as e:
+                    # tsdb's own validation (unknown func, quantile
+                    # without q, rate over a histogram): caller error,
+                    # not a 500
+                    self._send_json(400, {"error": "bad_query",
+                                          "detail": str(e),
+                                          "usage": self._QUERY_USAGE})
+                    return
+                self._send_json(200, doc)
 
             def log_message(self, *a):  # keep scrapes out of stderr
                 pass

@@ -173,7 +173,7 @@ class MetricsBeacon:
                  registry: Optional[MetricsRegistry] = None,
                  interval_s: float = 2.0,
                  tracer: Optional[SpanTracer] = None,
-                 trace_limit: int = 4096):
+                 trace_limit: int = 4096, tsdb=None):
         self.directory = str(directory)
         self.host = str(host) if host is not None else _default_host_id()
         if os.sep in self.host:
@@ -190,6 +190,10 @@ class MetricsBeacon:
             tracer = telemetry.get_tracer()
         self.tracer = tracer
         self.trace_limit = int(trace_limit)
+        # optional local history (ISSUE 16): with a store attached,
+        # every publish also records the source registry into it, so
+        # the beacon cadence doubles as the host's history cadence
+        self.tsdb = tsdb
         self.interval_s = float(interval_s)
         if self.interval_s <= 0:
             raise ValueError("interval_s must be > 0")
@@ -217,6 +221,8 @@ class MetricsBeacon:
         path = publish_beacon(self.directory, self.host, self.registry,
                               trace_events=traces)
         self._publishes.inc()
+        if self.tsdb is not None:
+            self.tsdb.record(self.registry)
         return path
 
     def _publish_loop(self) -> None:
@@ -296,7 +302,7 @@ class FleetRegistry:
 
     def __init__(self, directory=None, stale_after_s: float = 10.0,
                  trace_store: Optional[FleetTraceStore] = None,
-                 alerts=None):
+                 alerts=None, tsdb=None):
         self.directory = str(directory) if directory is not None else None
         self.stale_after_s = float(stale_after_s)
         self._lock = threading.Lock()
@@ -310,6 +316,15 @@ class FleetRegistry:
         # and exports its burn/budget/state families into the view,
         # so /metrics and /alerts answer from the SAME aggregation
         self.alerts = alerts
+        # embedded time-series store (ISSUE 16): every built view is
+        # recorded — host-tagged series AND the host="fleet" rollups —
+        # so /query answers range reads over the aggregation the
+        # alerts and the autoscaler actually consumed.  Pass a shared
+        # store to pool history with other recorders.
+        if tsdb is None:
+            from deeplearning4j_tpu.telemetry.tsdb import TimeSeriesStore
+            tsdb = TimeSeriesStore()
+        self.tsdb = tsdb
 
     # -- fold ----------------------------------------------------------
     def ingest(self, host: str, snapshot: dict,
@@ -545,6 +560,25 @@ class FleetRegistry:
         if self.alerts is not None:
             self.alerts.evaluate(view, now=now)
             self.alerts.export(view)
+        tstats = self.tsdb.stats()
+        view.gauge(
+            "fleet_tsdb_series",
+            "distinct series the embedded time-series store currently "
+            "holds history for (/query's universe)").set(
+                tstats["series"])
+        view.counter(
+            "fleet_tsdb_samples_total",
+            "timestamped samples the embedded time-series store has "
+            "recorded across all series").inc(tstats["samples_total"])
+        view.counter(
+            "fleet_tsdb_evicted_total",
+            "samples the store aged out or collapsed into the "
+            "downsampled tier — bounded history, not unbounded "
+            "growth").inc(tstats["evicted_total"])
+        # record the finished view WALL-clocked (the ``now`` above is
+        # monotonic staleness time): /query ranges line up with
+        # postmortem timelines and cross-host wall stamps
+        self.tsdb.record(view)
         return view
 
     @staticmethod

@@ -32,7 +32,22 @@ document a later process can render as a timeline
   :func:`salvage_bundles` promotes each black-box file whose
   (host, pid) never produced a real bundle into a
   ``reason="salvaged: ..."`` postmortem — the victim's last persisted
-  events and still-open spans survive their process.
+  events and still-open spans survive their process;
+
+* **pre-crash metric history** (ISSUE 16) — every bundle and black
+  box carries ``history``: the last ``history_s`` seconds of the
+  process time-series store (``telemetry.get_tsdb()`` unless an
+  explicit store is armed), downsampled per series, so the
+  postmortem shows each metric's TRAJECTORY into the crash, not one
+  final value;
+
+* **retention** — the shared dir must not grow without bound across
+  chaos drills and real incidents: ``install_dump(max_bundles=...,
+  max_bundle_age_s=...)`` caps ``_postmortem/`` and ``_flightrec/``
+  by count and age (oldest evicted first, one atomic unlink each,
+  counted by ``postmortem_bundles_evicted_total``), applied after
+  every bundle write and black-box persist; :func:`salvage_bundles`
+  accepts the same caps so salvage respects the rotation policy.
 
 The recorder's own traffic is observable
 (``flight_events_total{kind=}``, ``postmortem_bundles_total``), and
@@ -144,7 +159,10 @@ class FlightRecorder:
     # -- bundles -------------------------------------------------------
     def install_dump(self, directory, host: Optional[str] = None,
                      registry=None, tracer=None, alerts=None,
-                     persist_interval_s: Optional[float] = None
+                     persist_interval_s: Optional[float] = None,
+                     tsdb=None, history_s: float = 300.0,
+                     max_bundles: Optional[int] = 64,
+                     max_bundle_age_s: Optional[float] = None
                      ) -> "FlightRecorder":
         """Arm bundle writing: ``directory`` is the shared dir (the
         checkpoint/beacon dir is the natural choice), ``registry`` /
@@ -152,7 +170,12 @@ class FlightRecorder:
         ``alerts`` is an optional :class:`~.slo.AlertEngine` whose
         state rides in every bundle.  ``persist_interval_s`` starts
         the black-box daemon (periodic ring snapshots a SIGKILL
-        cannot suppress)."""
+        cannot suppress).  ``tsdb`` is the time-series store whose
+        last ``history_s`` seconds ride in every bundle as
+        pre-crash metric history (the process-wide store by
+        default); ``max_bundles`` / ``max_bundle_age_s`` cap the
+        bundle and black-box dirs by count and age after every
+        write (``None`` disables that axis)."""
         host = str(host) if host is not None else _default_host_id()
         if os.sep in host:
             raise ValueError(f"host {host!r} must be a plain name")
@@ -160,10 +183,25 @@ class FlightRecorder:
                     if persist_interval_s else None)
         if interval is not None and interval <= 0:
             raise ValueError("persist_interval_s must be > 0")
+        history_s = float(history_s)
+        if history_s <= 0:
+            raise ValueError("history_s must be > 0")
+        max_bundles = None if max_bundles is None else int(max_bundles)
+        if max_bundles is not None and max_bundles < 1:
+            # 0 would evict the bundle a crash just wrote — the one
+            # file the whole module exists to keep
+            raise ValueError("max_bundles must be >= 1 (or None)")
+        max_bundle_age_s = (None if max_bundle_age_s is None
+                            else float(max_bundle_age_s))
+        if max_bundle_age_s is not None and max_bundle_age_s <= 0:
+            raise ValueError("max_bundle_age_s must be > 0 (or None)")
         with self._lock:
             self._cfg = {"directory": str(directory), "host": host,
                          "registry": registry, "tracer": tracer,
-                         "alerts": alerts}
+                         "alerts": alerts, "tsdb": tsdb,
+                         "history_s": history_s,
+                         "max_bundles": max_bundles,
+                         "max_bundle_age_s": max_bundle_age_s}
             alive = (self._thread is not None
                      and self._thread.is_alive())
             if interval is not None and alive:
@@ -220,7 +258,24 @@ class FlightRecorder:
             doc["slo"] = alerts.state() if alerts is not None else None
         except Exception:            # a torn engine must not cost the
             doc["slo"] = None        # bundle its events
+        try:
+            tsdb = cfg.get("tsdb")
+            if tsdb is None:
+                from deeplearning4j_tpu import telemetry
+                tsdb = telemetry.get_tsdb()
+            doc["history"] = tsdb.dump_recent(
+                window_s=cfg.get("history_s", 300.0))
+        except Exception:            # same discipline as slo: history
+            doc["history"] = None    # must not cost the bundle
         return doc
+
+    def _prune(self, cfg: dict) -> None:
+        try:
+            prune_bundles(cfg["directory"], cfg.get("max_bundles"),
+                          cfg.get("max_bundle_age_s"))
+        except Exception:            # retention is best-effort; the
+            log.exception(           # bundle already landed
+                "flight recorder: bundle prune failed")
 
     def request_dump(self, reason: str, error: Optional[str] = None
                      ) -> Optional[str]:
@@ -252,6 +307,7 @@ class FlightRecorder:
                     "(watchdog trips, chaos kills, preemptions, "
                     "explicit dumps)")
             self._bundles.inc()
+            self._prune(cfg)
             log.warning("flight recorder: postmortem bundle %s (%s)",
                         path, reason)
             return path
@@ -272,6 +328,7 @@ class FlightRecorder:
         path = os.path.join(cfg["directory"], BLACKBOX_DIRNAME,
                             f"{cfg['host']}.json")
         atomic_publish_json(path, doc)
+        self._prune(cfg)
         return path
 
     def _persist_loop(self, interval: float,
@@ -315,13 +372,86 @@ def load_bundle(path: str) -> dict:
         return json.load(f)
 
 
-def salvage_bundles(directory) -> List[str]:
+def _prune_dir(dirpath: str, max_count: Optional[int],
+               max_age_s: Optional[float],
+               now: Optional[float] = None) -> List[str]:
+    """Evict ``.json`` files beyond the count cap or older than the
+    age cap, OLDEST first (mtime order — the same order
+    :func:`list_bundles` presents).  Each eviction is one unlink, so
+    a concurrent reader sees complete files or none; a file another
+    process already removed is skipped, never an error."""
+    if max_count is None and max_age_s is None:
+        return []
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return []
+    entries = []
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(dirpath, name)
+        try:
+            entries.append((os.path.getmtime(path), path))
+        except OSError:
+            continue                 # raced with another pruner
+    entries.sort()
+    now = time.time() if now is None else float(now)
+    doomed = []
+    if max_age_s is not None:
+        cutoff = now - max_age_s
+        doomed += [e for e in entries if e[0] < cutoff]
+        entries = [e for e in entries if e[0] >= cutoff]
+    if max_count is not None and len(entries) > max_count:
+        doomed += entries[:len(entries) - max_count]
+    removed: List[str] = []
+    for _, path in doomed:
+        try:
+            os.remove(path)
+            removed.append(path)
+        except OSError:
+            continue
+    return removed
+
+
+def prune_bundles(directory, max_bundles: Optional[int] = 64,
+                  max_age_s: Optional[float] = None) -> List[str]:
+    """Cap ``_postmortem/`` and ``_flightrec/`` under ``directory``
+    by count and age; returns the evicted paths (oldest-first per
+    dir).  Every eviction counts into
+    ``postmortem_bundles_evicted_total`` — silent rotation would
+    read as bundles that never happened."""
+    directory = str(directory)
+    removed: List[str] = []
+    for sub in (BUNDLE_DIRNAME, BLACKBOX_DIRNAME):
+        removed += _prune_dir(os.path.join(directory, sub),
+                              max_bundles, max_age_s)
+    if removed:
+        try:
+            from deeplearning4j_tpu import telemetry
+            telemetry.counter(
+                "postmortem_bundles_evicted_total",
+                "postmortem bundles and black-box snapshots evicted "
+                "by the retention policy (count/age caps)"
+            ).inc(len(removed))
+        except Exception:
+            pass                     # partially-imported package
+        log.info("flight recorder: retention evicted %d file(s) "
+                 "under %s", len(removed), directory)
+    return removed
+
+
+def salvage_bundles(directory, max_bundles: Optional[int] = None,
+                    max_age_s: Optional[float] = None) -> List[str]:
     """Promote black-box ring snapshots whose (host, pid) never wrote
     a real bundle into ``reason="salvaged: ..."`` postmortems — the
     SIGKILL path: the victim could not dump, but its black-box daemon
     left the last persisted ring + open spans behind.  Idempotent
     (an already-salvaged (host, pid) is skipped); returns the NEW
-    bundle paths."""
+    bundle paths.  ``max_bundles`` / ``max_age_s`` apply the same
+    rotation policy as the writer AFTER salvage, so a salvage sweep
+    respects the retention caps instead of resurrecting evicted
+    history past them."""
     directory = str(directory)
     from deeplearning4j_tpu.resilience.coordination import (
         atomic_publish_json)
@@ -336,7 +466,7 @@ def salvage_bundles(directory) -> List[str]:
     try:
         names = sorted(os.listdir(bbdir))
     except OSError:
-        return []
+        names = []       # no black boxes; retention below still runs
     out: List[str] = []
     for name in names:
         if not name.endswith(".json"):
@@ -355,4 +485,6 @@ def salvage_bundles(directory) -> List[str]:
                             f"{doc.get('pid', 0)}-salvaged.json")
         atomic_publish_json(path, doc)
         out.append(path)
+    if max_bundles is not None or max_age_s is not None:
+        prune_bundles(directory, max_bundles, max_age_s)
     return out

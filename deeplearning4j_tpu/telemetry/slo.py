@@ -59,12 +59,19 @@ to the alert alone), and ``serving.router.ServingFleet`` reads
 """
 from __future__ import annotations
 
-import bisect
 import json
+import logging
 import math
+import os
+import subprocess
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.telemetry.registry import _fmt_labels
+from deeplearning4j_tpu.telemetry.tsdb import TimeSeriesStore, is_reset
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 OBJECTIVES = ("availability", "latency", "ttft")
 
@@ -199,16 +206,18 @@ def _children(fam):
 
 class _SpecState:
     """One spec's fold state (mutated only under the engine lock):
-    the cumulative (t, good, bad) sample history (a time-ordered
-    LIST — window edges bisect into it), last raw totals for reset
-    detection, and the alert state machine."""
+    last raw totals for reset detection and the alert state machine.
+    The cumulative (good, bad) sample HISTORY lives in the engine's
+    shared :class:`~deeplearning4j_tpu.telemetry.tsdb.TimeSeriesStore`
+    (ISSUE 16) as ``fleet_slo_window_events{slo=}`` — one windowing/
+    reset encoding for the whole observability plane instead of a
+    private list here."""
 
-    __slots__ = ("samples", "last_good", "last_bad", "state", "t_cond",
+    __slots__ = ("last_good", "last_bad", "state", "t_cond",
                  "t_clear", "t_fired", "last_burns", "remaining",
                  "transitions")
 
     def __init__(self):
-        self.samples: List[Tuple[float, float, float]] = []
         self.last_good = None
         self.last_bad = None
         self.state = "inactive"
@@ -240,7 +249,8 @@ class AlertEngine:
     instead."""
 
     def __init__(self, specs: Iterable[SLOSpec], source=None,
-                 registry=None, interval_s: float = 5.0):
+                 registry=None, interval_s: float = 5.0,
+                 sinks: Iterable = (), history=None):
         self.specs: Tuple[SLOSpec, ...] = tuple(specs)
         if not self.specs:
             raise ValueError("AlertEngine needs >= 1 SLOSpec")
@@ -255,6 +265,17 @@ class AlertEngine:
             from deeplearning4j_tpu import telemetry
             registry = telemetry.get_registry()
         self.registry = registry
+        # notification egress (ISSUE 16 / ROADMAP 4d): sinks fire on
+        # pending->firing and firing->resolved transitions, exactly
+        # once per transition; a failing sink degrades (counted,
+        # logged), never raises into the evaluation loop
+        self.sinks = tuple(sinks)
+        # the shared history substrate (ISSUE 16): the (good, bad)
+        # sample windows live in a TimeSeriesStore under
+        # fleet_slo_window_events{slo=} — pass a shared store to pool
+        # history with other recorders, default is engine-private
+        self.history = history if history is not None \
+            else TimeSeriesStore()
         self._lock = threading.Lock()
         self._st: Dict[str, _SpecState] = {s.name: _SpecState()
                                            for s in self.specs}
@@ -284,6 +305,12 @@ class AlertEngine:
             "fleet_slo_alert_transitions_total",
             "alert state transitions per SLO, labeled by the state "
             "entered", labelnames=("slo", "to"))
+        self._notif = registry.counter(
+            "fleet_alert_notifications_total",
+            "alert notifications attempted per sink and result — "
+            "fired on pending->firing and firing->resolved, exactly "
+            "once per transition; errors degrade, never raise",
+            labelnames=("sink", "result"))
 
     # -- sampling ------------------------------------------------------
     def _read_counts(self, reg, spec: SLOSpec
@@ -345,56 +372,47 @@ class AlertEngine:
     #: thousand tuples instead of half a million.
     MAX_SAMPLES = 8192
 
+    def _series_key(self, spec: SLOSpec) -> str:
+        """This spec's history series in the shared store."""
+        return "fleet_slo_window_events" + _fmt_labels(
+            ("slo",), (spec.name,))
+
     def _sample_locked(self, st: _SpecState, spec: SLOSpec,
                        now: float, counts) -> None:
         if counts is None:
             return
         good, bad = counts
+        key = self._series_key(spec)
         if st.last_good is not None and (
-                good < st.last_good - 1e-9 or bad < st.last_bad - 1e-9):
+                is_reset(st.last_good, good)
+                or is_reset(st.last_bad, bad)):
             # reset epoch (worker restart / fresh view source): the
             # cumulative history no longer shares an origin with the
             # new totals — folding would manufacture negative deltas.
             # Re-prime instead; the budget window restarts with the
             # process, exactly like the fleet aggregator's rule.
-            st.samples.clear()
+            self.history.clear(key)
         st.last_good, st.last_bad = good, bad
-        if st.samples and now <= st.samples[-1][0]:
-            return                   # same instant (double-driven
-                                     # engine): keep the first sample
-        horizon = spec.horizon_s()
-        if (len(st.samples) >= 2 and
-                now - st.samples[-2][0] < horizon / self.MAX_SAMPLES):
-            # dense head: collapse the sub-gap intermediate point —
-            # the newest totals are what every window's right edge
-            # reads, the skipped point bought nothing
-            st.samples[-1] = (now, good, bad)
-        else:
-            st.samples.append((now, good, bad))
-        cut = 0
-        n = len(st.samples)
-        # keep ONE sample at-or-before the horizon so a full window
-        # always has a left edge to difference against
-        while n - cut > 2 and st.samples[cut + 1][0] < now - horizon:
-            cut += 1
-        if cut:
-            del st.samples[:cut]
+        # mode="slo" is this engine's exact windowed encoding (same-
+        # instant keep-first, dense-head collapse, keep-one-at-or-
+        # before-horizon trim), now shared via the store
+        self.history.append(key, now, (good, bad), kind="window",
+                            mode="slo", horizon_s=spec.horizon_s(),
+                            max_points=self.MAX_SAMPLES)
 
-    @staticmethod
-    def _window_counts(st: _SpecState, now: float, window_s: float
+    def _window_counts(self, spec: SLOSpec, now: float, window_s: float
                        ) -> Tuple[float, float]:
         """(good, bad) DELTAS over the trailing window: latest sample
         minus the newest sample at or before ``now - window_s`` (the
         oldest retained sample when history is shorter — a young
-        engine reads its whole history as the window).  The history
-        is time-ordered, so the edge lookup bisects."""
-        if not st.samples:
+        engine reads its whole history as the window).  The store's
+        history is time-ordered, so the edge lookup bisects."""
+        key = self._series_key(spec)
+        last = self.history.latest(key)
+        if last is None:
             return 0.0, 0.0
-        _t1, g1, b1 = st.samples[-1]
-        edge = now - window_s
-        i = bisect.bisect_right(st.samples, edge,
-                                key=lambda s: s[0]) - 1
-        _t0, g0, b0 = st.samples[max(0, i)]
+        g1, b1 = last[1]
+        g0, b0 = self.history.edge(key, now - window_s)[1]
         return max(0.0, g1 - g0), max(0.0, b1 - b0)
 
     # -- evaluation ----------------------------------------------------
@@ -431,13 +449,12 @@ class AlertEngine:
                 # first-blip flap the multi-window shape exists to
                 # prevent); its burn still REPORTS (the fraction seen
                 # so far), it just cannot meet the condition
-                span = (st.samples[-1][0] - st.samples[0][0]
-                        if len(st.samples) > 1 else 0.0)
+                span = self.history.span(self._series_key(spec))
                 for short_s, long_s, thresh, _sev in spec.windows:
                     bs = burn_rate(
-                        *self._window_counts(st, now, short_s),
+                        *self._window_counts(spec, now, short_s),
                         spec.budget)
-                    gl, bl_bad = self._window_counts(st, now, long_s)
+                    gl, bl_bad = self._window_counts(spec, now, long_s)
                     bl = burn_rate(gl, bl_bad, spec.budget)
                     burns[f"{short_s:g}s"] = bs
                     burns[f"{long_s:g}s"] = bl
@@ -446,7 +463,7 @@ class AlertEngine:
                             and bs >= thresh and bl >= thresh):
                         condition = True
                 st.last_burns = burns
-                wg, wb = self._window_counts(st, now, spec.window_s)
+                wg, wb = self._window_counts(spec, now, spec.window_s)
                 total = wg + wb
                 # budget CONSUMED so far: the observed bad fraction,
                 # scaled by how much of the budget window the history
@@ -481,7 +498,40 @@ class AlertEngine:
                 float(STATES.index(a["state"])))
         for name, to in transitions:
             self._trans.labels(slo=name, to=to).inc()
+        self._notify(transitions, out)
         return out
+
+    def _notify(self, transitions: List[Tuple[str, str]],
+                alerts: List[dict]) -> None:
+        """Deliver pending->firing / firing->resolved transitions to
+        every configured sink — exactly once per transition (the
+        transitions list holds each state entry once), outside the
+        engine lock.  A sink failure is counted and logged, never
+        raised: egress must not kill the evaluation loop."""
+        if not self.sinks:
+            return
+        notify = [(n, to) for n, to in transitions
+                  if to in ("firing", "resolved")]
+        if not notify:
+            return
+        byname = {a["slo"]: a for a in alerts}
+        for name, to in notify:
+            a = byname.get(name, {})
+            event = {"t": time.time(), "slo": name, "to": to,
+                     "state": a.get("state"),
+                     "burns": a.get("burns", {}),
+                     "budget_remaining": a.get("budget_remaining")}
+            for sink in self.sinks:
+                sname = getattr(sink, "name", type(sink).__name__)
+                try:
+                    sink.deliver(dict(event))
+                    self._notif.labels(sink=sname, result="ok").inc()
+                except Exception:
+                    log.exception(
+                        "alert sink %s failed delivering %s -> %s",
+                        sname, name, to)
+                    self._notif.labels(sink=sname,
+                                       result="error").inc()
 
     def _advance_locked(self, st: _SpecState, spec: SLOSpec,
                         now: float, condition: bool) -> List[str]:
@@ -607,8 +657,6 @@ class AlertEngine:
 
     # -- standalone loop ----------------------------------------------
     def _loop(self, stop: threading.Event) -> None:
-        import logging
-        log = logging.getLogger("deeplearning4j_tpu")
         while not stop.wait(self.interval_s):
             try:
                 self.evaluate()
@@ -647,3 +695,52 @@ class AlertEngine:
     def __exit__(self, *exc) -> bool:
         self.close()
         return False
+
+
+class WebhookFileSink:
+    """File-backed webhook egress (ROADMAP 4d): each notification
+    appends ONE JSON line to ``path`` (a directory gets
+    ``alerts.jsonl`` inside it — the shared-dir shape, beside the
+    beacons and bundles).  The append is a single ``O_APPEND`` write
+    of a complete line, so concurrent writers from several hosts
+    interleave whole records, never torn ones — the same contract an
+    HTTP webhook receiver's log would give, without inventing a
+    network dependency this image doesn't have."""
+
+    name = "webhook_file"
+
+    def __init__(self, path: str):
+        self.path = str(path)
+
+    def deliver(self, event: dict) -> None:
+        path = self.path
+        if os.path.isdir(path):
+            path = os.path.join(path, "alerts.jsonl")
+        data = (json.dumps(event) + "\n").encode()
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                     0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
+
+
+class CommandSink:
+    """Command egress: run ``argv`` once per notification with the
+    event JSON on stdin (the pager/webhook-relay hook shape).  A
+    non-zero exit or a hang past ``timeout_s`` raises — the engine's
+    delivery loop counts it as ``result="error"`` and moves on."""
+
+    name = "command"
+
+    def __init__(self, argv: Sequence[str], timeout_s: float = 10.0):
+        self.argv = [str(a) for a in argv]
+        if not self.argv:
+            raise ValueError("CommandSink needs a command to run")
+        self.timeout_s = float(timeout_s)
+
+    def deliver(self, event: dict) -> None:
+        subprocess.run(self.argv, input=json.dumps(event).encode(),
+                       stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL,
+                       timeout=self.timeout_s, check=True)

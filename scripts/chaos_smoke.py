@@ -82,7 +82,7 @@ def _load_check_telemetry():
     return mod
 
 
-def main() -> int:
+def main(min_history_s: float = 60.0) -> int:
     from deeplearning4j_tpu import (MultiLayerNetwork,
                                     NeuralNetConfiguration, resilience,
                                     telemetry)
@@ -103,6 +103,12 @@ def main() -> int:
     ct = _load_check_telemetry()
     registry = telemetry.get_registry()
     problems = []
+    # ISSUE 16: record the whole run into the embedded time-series
+    # store at beacon cadence — the SLO kill at the end must find
+    # >= min_history_s of pre-crash history in its bundle, and the
+    # live /query read must reproduce the burn window
+    tsdb = telemetry.get_tsdb()
+    tsdb.start_recorder(registry, interval_s=1.0)
 
     def counter(name):
         return registry.counter(name)
@@ -578,6 +584,24 @@ def main() -> int:
         [SLOSpec("inter-latency", objective="latency", target=0.9,
                  phase="queue", threshold_s=0.1, window_s=600.0,
                  windows=[(0.4, 1.2, 1.5, "page")])])
+    # the burning SLO reads the queue-phase latency series — by now
+    # the fleet/disagg/step-load scenarios have been feeding it for
+    # minutes, so this top-up is normally a no-op guard; it only
+    # sleeps when the preceding scenarios ran implausibly fast
+    def _queue_series_span():
+        spans = [tsdb.span(k) for k in tsdb.series()
+                 if k.startswith("fleet_request_phase_seconds")
+                 and 'phase="queue"' in k]
+        return max(spans, default=0.0)
+
+    history_by = time.monotonic() + min_history_s + 30.0
+    while (_queue_series_span() < min_history_s
+           and time.monotonic() < history_by):
+        time.sleep(0.25)
+    if _queue_series_span() < min_history_s:
+        problems.append(
+            f"queue-phase series never reached {min_history_s:g}s of "
+            f"recorded history (got {_queue_series_span():.1f}s)")
     recorder = telemetry.get_flight_recorder()
     recorder.install_dump(slo_dir, host="chaos", alerts=slo_eng)
     fleet3 = ServingFleet(gpt, n_replicas=1, n_slots=2, max_len=32,
@@ -623,11 +647,41 @@ def main() -> int:
         # transitions counter is monotonic, so the observation is
         # race-free even after the burn resolves)
         telemetry.publish_beacon(slo_dir, "chaos", registry=registry)
-        fr3 = telemetry.FleetRegistry(slo_dir, stale_after_s=3600.0)
+        # the aggregated view serves the PROCESS store at /query —
+        # the burn the engine decided on must be reproducible from
+        # the recorded history over HTTP (ISSUE 16)
+        fr3 = telemetry.FleetRegistry(slo_dir, stale_after_s=3600.0,
+                                      tsdb=tsdb)
         with telemetry.start_metrics_server(fr3, port=0) as srv3:
             agg_body = urllib.request.urlopen(
                 f"http://127.0.0.1:{srv3.port}/metrics",
                 timeout=5).read().decode()
+            fired = [a for a in slo_eng.alerts()
+                     if a["slo"] == "inter-latency"
+                     and a.get("t_fired") is not None]
+            if not fired:
+                problems.append("no fired inter-latency alert to "
+                                "check the /query burn window against")
+            else:
+                wall_fired = time.time() - (time.monotonic()
+                                            - fired[0]["t_fired"])
+                qdoc = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv3.port}/query?"
+                    "series=fleet_slo_burn_rate&slo=inter-latency&"
+                    f"window=1.2s&start={wall_fired - 5.0}&"
+                    f"end={time.time() + 1.0}",
+                    timeout=5).read().decode())
+                burns = [p[1] for r in qdoc.get("results", ())
+                         for p in r.get("points", ())]
+                if not burns:
+                    problems.append(
+                        "/query returned no burn-rate history over "
+                        f"the firing window ({qdoc})")
+                elif max(burns) < 1.5:
+                    problems.append(
+                        "/query burn-rate history never reached the "
+                        f"1.5 firing threshold (max {max(burns):.3g})"
+                        " — inconsistent with the engine's decision")
         for needle in ('fleet_slo_alert_transitions_total'
                        '{slo="inter-latency",to="firing",'
                        'host="chaos"}',
@@ -684,6 +738,28 @@ def main() -> int:
         if not any(e["src"] == "span" for e in entries):
             problems.append("postmortem timeline stitched no trace-"
                             "store spans")
+        # ISSUE 16: the bundle carries the victim's pre-crash metric
+        # history, and the burning SLO's underlying series spans the
+        # required window into the kill
+        hist = (bdoc.get("history") or {}).get("series") or {}
+        qspans = [pts[-1][0] - pts[0][0]
+                  for k, ent in hist.items()
+                  if k.startswith("fleet_request_phase_seconds")
+                  and 'phase="queue"' in k
+                  for pts in [ent.get("points") or []] if len(pts) > 1]
+        # dump_recent keeps the last 300s; the assert floor is the
+        # smaller of that and min_history_s, minus sampling slack
+        floor = min(min_history_s, 300.0) - 5.0
+        if not qspans:
+            problems.append("bundle history holds no queue-phase "
+                            "series (the burning SLO's source)")
+        elif max(qspans) < floor:
+            problems.append(
+                f"bundle history for the queue-phase series spans "
+                f"{max(qspans):.1f}s < {floor:.1f}s pre-crash")
+        if not pm.render_history(bdoc):
+            problems.append("postmortem render_history produced "
+                            "nothing for a bundle with history")
     shutil.rmtree(slo_dir, ignore_errors=True)
 
     # -- sanitizer: one deliberate nan trip so the series has a
@@ -794,6 +870,7 @@ def main() -> int:
     ]
     problems += ct.missing_series(body, required)
 
+    tsdb.close()
     print(json.dumps({"ok": not problems, "problems": problems}))
     return 1 if problems else 0
 

@@ -25,6 +25,7 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -206,6 +207,15 @@ FLIGHT_SERIES = [
     'flight_events_total{kind="admit"}',
     'flight_events_total{kind="retire"}',
     "postmortem_bundles_total",
+]
+
+# Embedded TSDB (ISSUE 16): every FleetRegistry records its view into
+# its store per scrape, so the store's own accounting rides the
+# AGGREGATED scrape (the SLO section's fleet endpoint asserts these).
+TSDB_SERIES = [
+    "fleet_tsdb_series",
+    "fleet_tsdb_samples_total",
+    "fleet_tsdb_evicted_total",
 ]
 
 # Predictive-autoscaling series (ISSUE 13): the forecaster below runs
@@ -693,6 +703,51 @@ def main() -> int:
             if states.get("smoke-avail") != "resolved":
                 problems.append("induced burn did not resolve after "
                                 f"clean traffic: {states}")
+            # ISSUE 16: the store's accounting on the aggregated
+            # scrape, and a live /query over the recorded history —
+            # the admitted counter's rate must be positive and
+            # consistent with its delta over the same window
+            problems += missing_series(slo_body, TSDB_SERIES)
+            qbase = (base + "/query?series=fleet_requests_total"
+                     "&tenant=smoke&outcome=admitted")
+            qr = json.loads(urllib.request.urlopen(
+                qbase, timeout=5).read().decode())
+            pts = [p for r in qr.get("results", ())
+                   for p in r.get("points", ())]
+            if len(pts) < 2:
+                problems.append("/query range over the admitted "
+                                f"counter held {len(pts)} samples "
+                                f"(< 2): {qr}")
+            qd = json.loads(urllib.request.urlopen(
+                qbase + "&func=delta", timeout=5).read().decode())
+            qrt = json.loads(urllib.request.urlopen(
+                qbase + "&func=rate", timeout=5).read().decode())
+            dv = [r["value"] for r in qd.get("results", ())
+                  if r.get("value") is not None]
+            rv = [r["value"] for r in qrt.get("results", ())
+                  if r.get("value") is not None]
+            if not dv or dv[0] <= 0:
+                problems.append("/query delta over the admitted "
+                                f"counter not positive: {qd}")
+            if not rv or rv[0] <= 0:
+                problems.append("/query rate over the admitted "
+                                f"counter not positive: {qrt}")
+            if dv and rv and len(pts) >= 2:
+                span = pts[-1][0] - pts[0][0]
+                if span > 0 and (abs(rv[0] * span - dv[0])
+                                 > 1e-6 + 0.1 * abs(dv[0])):
+                    problems.append(
+                        f"/query rate {rv[0]:g} inconsistent with "
+                        f"delta {dv[0]:g} over {span:.3f}s")
+            try:
+                urllib.request.urlopen(base + "/query?series=",
+                                       timeout=5)
+                problems.append("/query with an empty series "
+                                "selector did not answer 400")
+            except urllib.error.HTTPError as e:
+                if e.code != 400:
+                    problems.append("/query with an empty series "
+                                    f"selector answered {e.code}")
         # one explicit postmortem bundle: the dump path end to end
         recorder = telemetry.get_flight_recorder()
         recorder.install_dump(d, host="smokehost", alerts=slo_eng)

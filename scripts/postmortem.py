@@ -21,6 +21,14 @@ state), so "what was this replica doing when it died" reads top to
 bottom.  Importable: ``merge_timeline(bundle, trace_store)`` /
 ``render_timeline(entries)`` are what ``tests/test_slo.py`` and the
 chaos smoke assert against.
+
+Bundles also carry pre-crash metric HISTORY (ISSUE 16: the last
+minutes of the process time-series store, downsampled).  The text
+rendering appends one value timeline per series below the event
+timeline — same wall-clock format, so a metric's trajectory lines up
+against the events by eye — and ``--series <substr>`` (repeatable)
+inlines matching series' points INTO the merged timeline as
+``metric`` entries, interleaved with the decisions that moved them.
 """
 from __future__ import annotations
 
@@ -41,6 +49,25 @@ def _fmt_fields(d: dict, skip=("seq", "wall", "ts", "kind")) -> str:
                     if k not in skip and v is not None)
 
 
+def _fmt_value(v) -> str:
+    """One history sample, compact: histograms dump as count/sum,
+    window tuples as their elements, scalars as %g."""
+    if isinstance(v, dict):
+        return (f"count={v.get('count', 0):g}"
+                f" sum={v.get('sum', 0.0):.6g}")
+    if isinstance(v, (list, tuple)):
+        return "(" + ",".join(f"{x:g}" if isinstance(x, (int, float))
+                              else str(x) for x in v) + ")"
+    if isinstance(v, (int, float)):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _clock(wall: float) -> str:
+    return (time.strftime("%H:%M:%S", time.localtime(wall))
+            + f"{wall % 1:.3f}"[1:])
+
+
 def _flatten_tree(node, out, depth=0):
     out.append({"wall": float(node.get("wall", 0.0)), "src": "span",
                 "what": node["name"], "host": node.get("host"),
@@ -52,11 +79,14 @@ def _flatten_tree(node, out, depth=0):
         _flatten_tree(child, out, depth + 1)
 
 
-def merge_timeline(bundle: dict, trace_store=None) -> list:
+def merge_timeline(bundle: dict, trace_store=None,
+                   history_series=()) -> list:
     """Merge one bundle with the trace store's stitched trees into a
     wall-clock-sorted entry list.  Only traces the bundle's OWN
     events reference are pulled from the store — a fleet aggregator
-    holds every request; the postmortem wants the victim's."""
+    holds every request; the postmortem wants the victim's.
+    ``history_series`` substrings select bundle-history series whose
+    samples interleave as ``metric`` entries."""
     entries = []
     for ev in bundle.get("events", ()):
         entries.append({"wall": float(ev.get("wall", 0.0)),
@@ -83,6 +113,17 @@ def merge_timeline(bundle: dict, trace_store=None) -> list:
                        f"budget_remaining="
                        f"{alert['budget_remaining']:.3g} "
                        f"burns={alert['burns']}")})
+    if history_series:
+        series = (bundle.get("history") or {}).get("series") or {}
+        for key in sorted(series):
+            if not any(pat in key for pat in history_series):
+                continue
+            for point in series[key].get("points", ()):
+                entries.append({"wall": float(point[0]),
+                                "src": "metric", "what": key,
+                                "host": bundle.get("host"),
+                                "depth": 0,
+                                "detail": _fmt_value(point[1])})
     if trace_store is not None:
         traces = sorted({ev.get("trace")
                          for ev in bundle.get("events", ())
@@ -101,12 +142,38 @@ def render_timeline(entries, reason: str = "") -> str:
     lines = [f"postmortem timeline ({len(entries)} entries)"
              + (f" — {reason}" if reason else "")]
     for e in entries:
-        ts = time.strftime("%H:%M:%S", time.localtime(e["wall"]))
-        frac = f"{e['wall'] % 1:.3f}"[1:]
         pad = "  " * e.get("depth", 0)
-        lines.append(f"{ts}{frac} [{e['src']:>5}] {pad}{e['what']}"
+        lines.append(f"{_clock(e['wall'])} [{e['src']:>6}] "
+                     f"{pad}{e['what']}"
                      + (f" ({e['host']})" if e.get("host") else "")
                      + (f" {e['detail']}" if e.get("detail") else ""))
+    return "\n".join(lines)
+
+
+def render_history(bundle: dict, width: int = 8) -> str:
+    """One value timeline per history series: the span's wall-clock
+    bounds (same format as the event timeline — line them up by eye)
+    and up to ``width`` evenly-strided samples showing the
+    trajectory into the crash.  Empty string when the bundle
+    predates bundle history."""
+    history = bundle.get("history") or {}
+    series = history.get("series") or {}
+    if not series:
+        return ""
+    lines = [f"pre-crash metric history ({len(series)} series, "
+             f"last {history.get('window_s', 0.0):g}s)"]
+    for key in sorted(series):
+        pts = series[key].get("points") or []
+        if not pts:
+            continue
+        stride = max(1, -(-len(pts) // max(1, int(width))))
+        shown = list(pts[::stride])
+        if shown[-1] is not pts[-1]:
+            shown.append(pts[-1])
+        vals = " | ".join(_fmt_value(p[1]) for p in shown)
+        lines.append(f"  {_clock(float(pts[0][0]))}"
+                     f"..{_clock(float(pts[-1][0]))} "
+                     f"{key} [{len(pts)}pt]: {vals}")
     return "\n".join(lines)
 
 
@@ -133,6 +200,9 @@ def main(argv=None) -> int:
                     "snapshots into bundles first")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the merged timeline as JSON")
+    ap.add_argument("--series", action="append", default=[],
+                    help="substring of bundle-history series to "
+                    "inline into the timeline (repeatable)")
     args = ap.parse_args(argv)
 
     if args.salvage:
@@ -156,14 +226,19 @@ def main(argv=None) -> int:
             return 1
         path = match[0]
     bundle = flightrec.load_bundle(path)
-    entries = merge_timeline(bundle, build_trace_store(args.shared_dir))
+    entries = merge_timeline(bundle, build_trace_store(args.shared_dir),
+                             history_series=args.series)
     if args.as_json:
         print(json.dumps({"ok": True, "bundle": os.path.basename(path),
                           "reason": bundle.get("reason"),
                           "host": bundle.get("host"),
-                          "entries": entries}))
+                          "entries": entries,
+                          "history": bundle.get("history")}))
     else:
         print(render_timeline(entries, bundle.get("reason", "")))
+        history = render_history(bundle)
+        if history:
+            print("\n" + history)
         print(f"\nbundle: {path}")
     return 0
 

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Tier-1 log comparator — name the failures a saturated run hides.
+
+The tier-1 gate runs ``pytest -q`` under a hard wall-clock budget and
+is EXPECTED to be cut off by ``timeout`` (rc 124): the signal is the
+glyph stream, not the exit code, and the short-summary section that
+would name failures usually never prints.  Comparing two runs by
+counting dots alone can mask a regression that trades one failure for
+another, so this script maps each progress glyph back to a TEST NAME
+by position against the collection order (stable: the gate pins
+``-p no:randomly``), then diffs the two runs name-by-name:
+
+    python scripts/t1_compare.py BASELINE.log CURRENT.log
+    python scripts/t1_compare.py BASELINE.log CURRENT.log \
+        --collect collected.txt      # reuse a saved collection list
+
+Without ``--collect`` the collection order is recomputed by running
+``pytest --collect-only -q`` with the gate's own flags (slow — the
+repo imports heavy modules at collection).  Output: the DOTS_PASSED
+delta, failures that vanished, and NOVEL failure names; exit 1 iff
+the current run shows an F/E at a position the baseline passed (or
+any F/E past the baseline's truncation point on a test the baseline
+never reached is reported but NOT novel — it was unobserved, not
+green).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+#: a pytest -q progress line: glyphs, optionally a percent marker
+GLYPH_RE = re.compile(r"^([.FEsxX]+)( *\[ *\d+%\])?$")
+
+#: the gate's own collection flags (ROADMAP tier-1 recipe)
+COLLECT_ARGS = ["-m", "pytest", "tests/", "-q", "-m", "not slow",
+                "--collect-only", "--continue-on-collection-errors",
+                "-p", "no:cacheprovider", "-p", "no:xdist",
+                "-p", "no:randomly"]
+
+
+def parse_glyphs(path: str) -> str:
+    """Concatenate the progress glyphs of one ``pytest -q`` log, in
+    order.  A timeout-truncated log just yields a shorter stream."""
+    out = []
+    with open(path, errors="replace") as f:
+        for line in f:
+            m = GLYPH_RE.match(line.rstrip("\n"))
+            if m:
+                out.append(m.group(1))
+    return "".join(out)
+
+
+def collection_order(collect_file=None):
+    """Test ids in collection order: from a saved ``--collect-only
+    -q`` listing, or by running collection with the gate's flags."""
+    if collect_file:
+        with open(collect_file, errors="replace") as f:
+            lines = f.read().splitlines()
+    else:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run([sys.executable] + COLLECT_ARGS,
+                              capture_output=True, text=True, env=env)
+        lines = proc.stdout.splitlines()
+    return [ln.strip() for ln in lines
+            if "::" in ln and " " not in ln.strip()]
+
+
+def outcomes(glyphs: str, order):
+    """Position-map glyphs to names.  Returns (by_name, n_unmapped):
+    glyph i belongs to test i while the collection list covers it;
+    glyphs past the list (collection drift) stay unmapped and are
+    surfaced rather than silently dropped."""
+    by_name = {}
+    for i, g in enumerate(glyphs):
+        if i < len(order):
+            by_name[order[i]] = g
+    return by_name, max(0, len(glyphs) - len(order))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("baseline", help="baseline tier-1 log")
+    ap.add_argument("current", help="current tier-1 log")
+    ap.add_argument("--collect", default=None,
+                    help="saved `pytest --collect-only -q` output "
+                    "(skips recomputing collection)")
+    args = ap.parse_args(argv)
+
+    base_g = parse_glyphs(args.baseline)
+    cur_g = parse_glyphs(args.current)
+    order = collection_order(args.collect)
+    if not order:
+        print(json.dumps({"ok": False,
+                          "error": "empty collection order"}))
+        return 2
+    base, base_extra = outcomes(base_g, order)
+    cur, cur_extra = outcomes(cur_g, order)
+
+    def bad(d):
+        return {n for n, g in d.items() if g in "FE"}
+
+    novel = sorted(n for n in bad(cur)
+                   if base.get(n) not in (None, "F", "E"))
+    unobserved = sorted(n for n in bad(cur) if n not in base)
+    fixed = sorted(n for n in bad(base)
+                   if cur.get(n) not in (None, "F", "E"))
+    doc = {
+        "dots_baseline": base_g.count("."),
+        "dots_current": cur_g.count("."),
+        "dots_delta": cur_g.count(".") - base_g.count("."),
+        "glyphs_baseline": len(base_g),
+        "glyphs_current": len(cur_g),
+        "novel_failures": novel,
+        "failures_past_baseline_truncation": unobserved,
+        "fixed_failures": fixed,
+        "unmapped_glyphs": {"baseline": base_extra,
+                            "current": cur_extra},
+        "ok": not novel,
+    }
+    print(json.dumps(doc, indent=2))
+    return 1 if novel else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

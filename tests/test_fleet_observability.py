@@ -736,11 +736,11 @@ def test_conc_rules_see_telemetry_fleet():
 def test_conc_rules_see_profiler_store_and_forecast_path():
     """Satellite (ISSUE 13): the whole-package lint must SEE the new
     shared-state owners — DeviceProfiler's sampling/XProf state, the
-    FleetTraceStore, the BacklogForecaster's shared window — and
-    produce ZERO findings for them (new threads + shared windows are
-    exactly its ROADMAP-item-5 blind-spot list)."""
+    FleetTraceStore, the TimeSeriesStore's history rings (which now
+    back the forecaster's window — ISSUE 16) — and produce ZERO
+    findings for them (new threads + shared windows are exactly its
+    ROADMAP-item-5 blind-spot list)."""
     from deeplearning4j_tpu.analysis import concurrency_lint, package_index
-    from deeplearning4j_tpu import serving as _serving
     from deeplearning4j_tpu import telemetry as _telemetry
     findings = []
     for pkgmod, fname, cls, attrs in (
@@ -748,11 +748,11 @@ def test_conc_rules_see_profiler_store_and_forecast_path():
              ("_calls", "_xprof_dir", "_xprof_left")),
             (_telemetry, "telemetry/tracing.py", "FleetTraceStore",
              ("_traces",)),
-            # the forecaster's window deque mutates via method calls
-            # (append/popleft) — guarded-store inference only counts
-            # plain attribute stores, so assert its lock + the
-            # zero-findings bar below
-            (_serving, "serving/autoscale.py", "BacklogForecaster",
+            # the forecaster's window moved into the shared
+            # TimeSeriesStore (ISSUE 16) — the store owns the lock
+            # now; its rings mutate via method calls, so assert its
+            # lock + the zero-findings bar below
+            (_telemetry, "telemetry/tsdb.py", "TimeSeriesStore",
              ())):
         pkg = os.path.dirname(pkgmod.__file__)
         index, _pf, _stats = package_index.build_index(pkg, root=REPO)

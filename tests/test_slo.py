@@ -415,7 +415,7 @@ def test_endpoints_404_400_and_alerts(tmp_path):
         assert code == 404
         doc = json.loads(body)
         assert set(doc["endpoints"]) == {"/metrics", "/traces",
-                                         "/alerts"}
+                                         "/alerts", "/query"}
         # malformed /traces queries: 400 + JSON error, never a trace
         for q in ("/traces?id=", "/traces?id=a&id=b", "/traces?bogus=1"):
             code, body = _get(base + q)

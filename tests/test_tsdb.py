@@ -1,0 +1,309 @@
+"""Embedded time-series store + /query endpoint + alert egress
+(ISSUE 16).
+
+Hand-pinned window math first (range/rate/delta over a
+worker-restart reset, quantile_over_time through the histogram
+bucket path, the raw->downsampled tier boundary with its eviction
+accounting), then the HTTP surface (/query over a fleet registry AND
+a plain registry carrying a ``.tsdb`` attribute, label matchers, the
+400 discipline), then the egress satellites: webhook-file /
+command sinks delivering EXACTLY once per pending->firing /
+firing->resolved transition, and bundle retention + pre-crash
+history in the flight recorder.  Kept lean — the tier-1 budget is
+saturated; chaos_smoke carries the end-to-end burn-window replay.
+"""
+import json
+import math
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import (FleetRegistry, MetricsRegistry,
+                                          flightrec)
+from deeplearning4j_tpu.telemetry.flightrec import FlightRecorder
+from deeplearning4j_tpu.telemetry.slo import (AlertEngine, CommandSink,
+                                              SLOSpec, WebhookFileSink)
+from deeplearning4j_tpu.telemetry.tsdb import (TimeSeriesStore, is_reset,
+                                               window_quantile)
+
+approx = pytest.approx
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# window math, hand-pinned
+# ---------------------------------------------------------------------------
+
+def test_range_rate_delta_across_a_reset():
+    st = TimeSeriesStore()
+    # a counter that restarts at t=20 (worker restart): 10 -> 20,
+    # RESET to 5, -> 15.  increase = 10 + 5 + 10 = 25 over 30s.
+    for t, v in ((0.0, 10.0), (10.0, 20.0), (20.0, 5.0), (30.0, 15.0)):
+        st.append("c_total", t, v, kind="counter")
+    assert is_reset(20.0, 5.0) and not is_reset(5.0, 15.0)
+    assert st.points("c_total", 5.0, 25.0) == [(10.0, 20.0),
+                                               (20.0, 5.0)]
+    assert st.delta("c_total", 0.0, 30.0) == approx(25.0)
+    assert st.rate("c_total", 0.0, 30.0) == approx(25.0 / 30.0)
+    # delta against the at-or-before edge: base is the t=10 sample
+    assert st.delta("c_total", 15.0, 30.0) == approx(5.0 + 10.0)
+    # no coverage at all -> None, not 0
+    assert st.delta("missing", 0.0, 30.0) is None
+    assert st.rate("c_total", 0.0, 0.5) is None   # < 2 samples
+
+
+def test_quantile_over_time_via_bucket_math():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    st = TimeSeriesStore()
+    st.record(reg, now=0.0)
+    for _ in range(3):
+        h.observe(0.5)
+    st.record(reg, now=10.0)
+    # the window's NEW observations all land in the (0.1, 1.0]
+    # bucket: the median interpolates halfway through it
+    assert st.quantile_over_time("lat_seconds", 0.5,
+                                 0.0, 10.0) == approx(0.55)
+    # direct bucket math agrees
+    assert window_quantile((0.1, 1.0), [0.0, 3.0, 0.0],
+                           0.5) == approx(0.55)
+    # an empty window is NaN, and a non-histogram series is None
+    assert math.isnan(st.quantile_over_time("lat_seconds", 0.5,
+                                            20.0, 30.0))
+    st.append("g", 0.0, 1.0)
+    assert st.quantile_over_time("g", 0.5, 0.0, 10.0) is None
+
+
+def test_two_tier_boundary_and_eviction_accounting():
+    st = TimeSeriesStore(raw_window_s=10.0, max_raw_points=1024,
+                         down_interval_s=5.0, retention_s=100.0)
+    for t in range(40):
+        st.append("g", float(t), float(t))
+    pts = st.points("g")
+    assert [v for _, v in pts][-1] == 39.0
+    assert pts == sorted(pts)
+    # raw keeps the last 10s; older samples collapsed to one per 5s
+    # bucket (keep-newest), so the old tier thinned out
+    raw = [p for p in pts if p[0] >= 39.0 - 10.0]
+    older = [p for p in pts if p[0] < 39.0 - 10.0]
+    assert len(raw) >= 10 and 0 < len(older) <= 40 - len(raw)
+    gaps = [b[0] - a[0] for a, b in zip(older, older[1:])]
+    # full interior buckets are one point per 5s; the newest old-tier
+    # bucket may still be partial at the raw boundary
+    assert gaps and all(g >= 5.0 for g in gaps[:-1])
+    s = st.stats()
+    assert s["series"] == 1 and s["samples_total"] == 40
+    assert s["evicted_total"] > 0
+    assert s["points"] == len(pts)
+
+
+def test_record_and_query_with_label_matchers():
+    reg = MetricsRegistry()
+    fam = reg.counter("req_total", labelnames=("tenant",))
+    fam.labels(tenant="a").inc(2)
+    fam.labels(tenant="b").inc(7)
+    st = TimeSeriesStore()
+    st.record(reg, now=100.0)
+    fam.labels(tenant="a").inc(1)
+    st.record(reg, now=110.0)
+    doc = st.query("req_total", matchers=[("tenant", "a")],
+                   start=90.0, end=120.0)
+    assert doc["matched"] == 1
+    assert doc["results"][0]["series"] == 'req_total{tenant="a"}'
+    assert [v for _, v in doc["results"][0]["points"]] == [2.0, 3.0]
+    assert st.query("req_total", start=90.0, end=120.0)["matched"] == 2
+    assert st.query("nope")["matched"] == 0
+    with pytest.raises(ValueError):
+        st.query("req_total", func="bogus")
+    with pytest.raises(ValueError):
+        st.query("req_total", func="quantile")        # q required
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface
+# ---------------------------------------------------------------------------
+
+def test_query_endpoint_on_fleet_registry(tmp_path):
+    src = MetricsRegistry()
+    fam = src.counter("fleet_requests_total",
+                      labelnames=("tenant", "outcome"))
+    fam.labels(tenant="a", outcome="ok").inc(3)
+    telemetry.publish_beacon(tmp_path, "h0", registry=src)
+    fr = FleetRegistry(tmp_path, stale_after_s=3600.0)
+    with telemetry.start_metrics_server(fr, port=0) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        assert _get(base + "/metrics")[0] == 200      # records once
+        fam.labels(tenant="a", outcome="ok").inc(2)
+        telemetry.publish_beacon(tmp_path, "h0", registry=src)
+        code, body = _get(base + "/query?series=fleet_requests_total"
+                          "&tenant=a")
+        assert code == 200
+        doc = json.loads(body)
+        # the per-host series AND the host="fleet" rollup both match
+        hosts = {s["series"].rsplit('host="', 1)[1].rstrip('"}')
+                 for s in doc["results"]}
+        assert hosts == {"h0", "fleet"}
+        for s in doc["results"]:
+            assert [v for _, v in s["points"]][-1] == 5.0
+        # rate over the recorded increase is positive and finite
+        code, body = _get(base + "/query?series=fleet_requests_total"
+                          "&tenant=a&host=h0&func=rate")
+        vals = [r["value"] for r in json.loads(body)["results"]]
+        assert code == 200 and vals and vals[0] > 0
+        # 404 names /query beside the other endpoints
+        code, body = _get(base + "/nope")
+        assert code == 404
+        assert "/query" in json.loads(body)["endpoints"]
+        # 400 discipline: missing/empty series, repeats, bad numbers,
+        # bad func, quantile without q
+        for q in ("/query", "/query?series=", "/query?series=a&series=b",
+                  "/query?series=a&start=x", "/query?series=a&func=nope",
+                  "/query?series=a&func=quantile"):
+            code, body = _get(base + q)
+            assert code == 400, q
+            assert json.loads(body)["error"] == "bad_query"
+
+
+def test_query_endpoint_on_plain_registry():
+    reg = MetricsRegistry()
+    reg.counter("jobs_total").inc(4)
+    reg.tsdb = TimeSeriesStore()
+    reg.tsdb.record(reg)
+    with telemetry.start_metrics_server(reg, port=0) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        code, body = _get(base + "/query?series=jobs_total")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["matched"] == 1
+        assert [v for _, v in doc["results"][0]["points"]] == [4.0]
+        code, body = _get(base + "/nope")
+        assert code == 404
+        assert json.loads(body)["endpoints"] == ["/metrics", "/query"]
+
+
+# ---------------------------------------------------------------------------
+# alert egress sinks (exactly once per transition)
+# ---------------------------------------------------------------------------
+
+def _sink_engine(tmp_path, sinks):
+    src = MetricsRegistry()
+    src.counter("fleet_requests_total",
+                labelnames=("tenant", "outcome"))
+    reg = MetricsRegistry()
+    spec = SLOSpec("egress", objective="availability", target=0.9,
+                   window_s=100.0, windows=[(4.0, 8.0, 1.5, "page")])
+    return AlertEngine([spec], source=src, registry=reg,
+                       sinks=sinks), src, reg
+
+
+def test_webhook_file_sink_exactly_once_per_transition(tmp_path):
+    hook = tmp_path / "alerts.jsonl"
+    bad = CommandSink([os.path.join(str(tmp_path), "no-such-bin")])
+    eng, src, reg = _sink_engine(tmp_path,
+                                 [WebhookFileSink(hook), bad])
+    fam = src.counter("fleet_requests_total",
+                      labelnames=("tenant", "outcome"))
+    eng.evaluate(now=0.0)                             # prime
+    fam.labels(tenant="a", outcome="failed").inc(5)
+    assert eng.evaluate(now=10.0)[0]["state"] == "firing"
+    eng.evaluate(now=11.0)                 # still firing: no new event
+    fam.labels(tenant="a", outcome="ok").inc(500)
+    eng.evaluate(now=20.0)
+    a = eng.evaluate(now=30.0)[0]
+    assert a["state"] in ("resolved", "inactive")
+    events = [json.loads(ln) for ln in
+              hook.read_text().splitlines() if ln]
+    assert [e["to"] for e in events] == ["firing", "resolved"]
+    assert all(e["slo"] == "egress" and "t" in e and "burns" in e
+               for e in events)
+    # counted per sink/result; the dead command sink degraded to an
+    # error count, never an exception out of evaluate()
+    notif = reg.counter("fleet_alert_notifications_total",
+                        labelnames=("sink", "result"))
+    assert notif.labels(sink="webhook_file", result="ok").value == 2
+    assert notif.labels(sink="command", result="error").value == 2
+
+
+def test_command_sink_delivers_stdin_json(tmp_path):
+    out = tmp_path / "delivered.json"
+    import sys
+    sink = CommandSink([sys.executable, "-c",
+                        "import sys; open(%r, 'w').write("
+                        "sys.stdin.read())" % str(out)])
+    sink.deliver({"slo": "x", "to": "firing"})
+    assert json.loads(out.read_text())["to"] == "firing"
+    with pytest.raises(ValueError):
+        CommandSink([])
+
+
+# ---------------------------------------------------------------------------
+# bundle history + retention
+# ---------------------------------------------------------------------------
+
+def test_bundle_history_and_retention(tmp_path):
+    d = str(tmp_path)
+    store = TimeSeriesStore()
+    now = time.time()
+    for i in range(5):
+        store.append("fleet_queue_depth", now - 50.0 + i * 10.0,
+                     float(i), kind="gauge")
+    fr = FlightRecorder(capacity=16)
+    fr.record("dispatch", replica=0)
+    fr.install_dump(d, host="h", tsdb=store, history_s=120.0,
+                    max_bundles=2)
+    paths = [fr.request_dump(f"drill {i}") for i in range(4)]
+    assert all(paths)
+    kept = flightrec.list_bundles(d)
+    # retention kept the NEWEST two; the one just written survives
+    assert len(kept) == 2
+    assert paths[-1] in kept and paths[0] not in kept
+    doc = flightrec.load_bundle(paths[-1])
+    hist = doc["history"]["series"]["fleet_queue_depth"]
+    assert hist["kind"] == "gauge"
+    assert [v for _, v in hist["points"]] == [0.0, 1.0, 2.0, 3.0, 4.0]
+    assert (hist["points"][-1][0] - hist["points"][0][0]
+            == approx(40.0))
+    fr.uninstall_dump()
+    # salvage respects the same rotation caps
+    assert flightrec.salvage_bundles(d, max_bundles=1) == []
+    assert len(flightrec.list_bundles(d)) == 1
+    with pytest.raises(ValueError):
+        fr.install_dump(d, host="h", max_bundles=0)
+
+
+def test_postmortem_renders_history_timelines(tmp_path):
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "postmortem", os.path.join(repo, "scripts", "postmortem.py"))
+    pm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pm)
+    bundle = {"host": "h", "t": 100.0, "events": [
+                  {"wall": 95.0, "kind": "dispatch", "seq": 0}],
+              "history": {"window_s": 60.0, "t": 100.0, "series": {
+                  "q_depth": {"kind": "gauge",
+                              "points": [[90.0, 1.0], [95.0, 3.0]]},
+                  "lat": {"kind": "histogram", "points": [
+                      [95.0, {"count": 2.0, "sum": 0.5}]]}}}}
+    text = pm.render_history(bundle)
+    assert "2 series" in text and "q_depth" in text
+    assert "count=2 sum=0.5" in text
+    # --series inlines matching samples INTO the merged timeline,
+    # interleaved with the ring events by wall clock
+    entries = pm.merge_timeline(bundle, history_series=["q_depth"])
+    kinds = [(e["src"], e["wall"]) for e in entries]
+    assert ("metric", 90.0) in kinds and ("metric", 95.0) in kinds
+    assert ("event", 95.0) in kinds
+    assert pm.render_history({"history": None}) == ""
