@@ -998,6 +998,148 @@ def bench_serving_disagg(n_replicas=2, n_slots=8, long_len=384,
                     "kv_blocks_device"}
 
 
+def bench_serving_mesh(tp_ladder=(1, 2), n_slots=4, prompt_len=12,
+                       n_new=48, n_requests=8, tick_batch=8,
+                       block_size=16, smoke=False):
+    """Mesh-sharded decode ladder -> SERVING_MESH_r17.json (ISSUE 17):
+    ONE replica spanning chips.  Per tp rung: the same trace through a
+    ``GenerationServer`` on ``tp`` devices (tp=1 is the unsharded
+    baseline, tp=2 builds the data x tp NamedSharding mesh) —
+    new-tokens/s, TTFT p50/p99, and a speculative pass (full-depth
+    self-draft) whose acceptance rate proves draft + verify run
+    through the sharded programs.  Outputs are byte-compared across
+    rungs AND against the non-speculative baseline inside the window:
+    the bench fails rather than report a rate that broke parity.
+    ``smoke=True`` shrinks to the small CPU config (the artifact CI
+    records); on a shared-host CPU both rungs run the same silicon,
+    so vs_baseline ~ 1x minus the all-gather overhead is the expected
+    reading — the TPU run is where tp=2 buys real HBM bandwidth.
+    Acceptance: vs_baseline >= 0.7 (sharding overhead never costs
+    more than 30% of the single-chip rate, even where it buys no
+    extra silicon)."""
+    import jax
+    from deeplearning4j_tpu.parallel import GenerationServer
+    from deeplearning4j_tpu.zoo.gpt import Gpt
+
+    if smoke:
+        n_slots, prompt_len, n_new, n_requests = 2, 8, 24, 4
+        block_size = 4
+        # deliberately the FAT smoke net (~6.4M params — ~1.5x the
+        # notional 16MB fp32 virtual-chip budget the README recipe
+        # documents): the per-tick matmuls must dominate the mesh
+        # all-gathers or the smoke measures dispatch overhead, and
+        # the whole point of the rung is a net one chip can't hold
+        m = Gpt(vocab_size=50, max_len=64, d_model=256, n_layers=4,
+                n_heads=4, d_ff=1024, seq_len=8, compute_dtype=None,
+                seed=3)
+        compute_dtype = None
+    else:
+        if jax.default_backend() not in ("tpu",):
+            raise RuntimeError(
+                "serving_mesh bench requires a TPU backend "
+                "(smoke=True for the CPU config)")
+        m = Gpt(seq_len=prompt_len, max_len=prompt_len + n_new)
+        compute_dtype = "bfloat16"
+    net = m.init_graph()
+    max_len = prompt_len + n_new
+    rng = np.random.default_rng(0)
+    vocab = m.vocab_size
+    prompts = [rng.integers(0, vocab, prompt_len).astype(np.int32)
+               for _ in range(n_requests)]
+
+    def pct(ttfts, q):
+        vals = [t for t in ttfts if t is not None]
+        return round(float(np.percentile(vals, q)), 4) if vals else None
+
+    def window(srv):
+        # warm every compile variant off-window (full budget + the
+        # short-round variants admission can hit), then decode the
+        # whole trace concurrently; _trials puts a variance band on
+        # the rate — the 4x24-token window is short enough that a
+        # single trial swings past the 0.7 acceptance line on noise
+        srv.submit(prompts[0], n_new=n_new)
+        srv.submit(prompts[0], n_new=1)
+        outs_box, ttfts_box = [], []
+
+        def trial():
+            t0 = time.perf_counter()
+            handles = [srv.submit_async(p, n_new=n_new)
+                       for p in prompts]
+            outs_box[:] = [h.result(timeout=600) for h in handles]
+            dt = time.perf_counter() - t0
+            ttfts_box[:] = [h.ttft for h in handles]
+            return n_requests * n_new / dt
+
+        mean, sigma, _ = _trials(trial)
+        return mean, sigma, outs_box, ttfts_box
+
+    n_layers = m.n_layers if hasattr(m, "n_layers") else 4
+    base_kw = dict(n_slots=n_slots, max_len=max_len,
+                   compute_dtype=compute_dtype, block_size=block_size,
+                   tick_batch=tick_batch, tick_timeout_s=None)
+    ladder, base_outs = [], None
+    for tp in tp_ladder:
+        if tp > 1 and len(jax.devices()) < tp:
+            ladder.append({"tp": tp, "skipped":
+                           f"only {len(jax.devices())} devices"})
+            continue
+        dev = None if tp == 1 else jax.devices()[:tp]
+        with GenerationServer(net, devices=dev, **base_kw) as srv:
+            tps, sigma, outs, ttfts = window(srv)
+            st = srv.stats()
+        with GenerationServer(net, devices=dev, speculative={
+                "k": 2, "rounds": 2, "draft_layers": n_layers},
+                **base_kw) as srv:
+            spec_tps, _, spec_outs, _ = window(srv)
+            spec_st = srv.stats()
+        if base_outs is None:
+            base_outs = outs
+        for a, b, c in zip(outs, spec_outs, base_outs):
+            if not (np.array_equal(a, c) and np.array_equal(b, c)):
+                raise AssertionError(
+                    f"tp={tp} output diverged from the tp=1 "
+                    "non-speculative baseline — sharding broke parity")
+        ladder.append({
+            "tp": tp,
+            "devices": st["devices"],
+            "route": "reference_tp" if st["tp"] > 1 else "pallas",
+            "new_tokens_per_sec": round(tps, 1),
+            "sigma": round(sigma, 1),
+            "ttft_p50_s": pct(ttfts, 50),
+            "ttft_p99_s": pct(ttfts, 99),
+            "spec_tokens_per_sec": round(spec_tps, 1),
+            "spec_acceptance_rate": round(
+                spec_st["spec_acceptance_rate"], 4),
+        })
+    ran = [r for r in ladder if "skipped" not in r]
+    top = ran[-1]
+    return {"metric": "serving_mesh_decode",
+            "value": top["new_tokens_per_sec"],
+            "unit": "new_tokens_per_sec",
+            "model": ("tiny CPU-smoke Gpt" if smoke
+                      else "zoo.Gpt GPT-2-small-shaped"),
+            "smoke": smoke, "n_slots": n_slots,
+            "prompt_len": prompt_len, "n_new": n_new,
+            "n_requests": n_requests, "tick_batch": tick_batch,
+            "block_size": block_size,
+            "vs_baseline": round(
+                top["new_tokens_per_sec"]
+                / max(ran[0]["new_tokens_per_sec"], 1e-9), 3),
+            "ladder": ladder,
+            "parity": "byte-checked across rungs and vs non-spec "
+                      "baseline in-window",
+            "note": "value is new-tokens/s at the largest tp rung; "
+                    "vs_baseline is the x-over the tp=1 rung on the "
+                    "SAME trace, outputs byte-checked (parity by "
+                    "construction: weights shard output axes only, "
+                    "rep() all-gathers before every contraction).  "
+                    "On the shared-host CPU smoke both rungs run the "
+                    "same silicon, so >= 0.7 (all-gather overhead "
+                    "bounded) is the acceptance; on TPU tp=2 halves "
+                    "per-chip KV residency and the ladder should "
+                    "climb toward the HBM-bandwidth roofline"}
+
+
 def bench_mnist_mlp():
     import jax
     import jax.numpy as jnp
@@ -1052,7 +1194,8 @@ def main():
     result["secondary"] = []
     for fn in (bench_bert, bench_bert_imported, bench_gpt,
                bench_serving_decode, bench_speculative,
-               bench_serving_fleet, bench_serving_disagg):
+               bench_serving_fleet, bench_serving_disagg,
+               bench_serving_mesh):
         try:
             result["secondary"].append(fn())
         except Exception as e:  # secondaries must never sink the primary

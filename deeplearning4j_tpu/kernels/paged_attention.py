@@ -68,6 +68,12 @@ _ROUTE_TOTAL = telemetry.counter(
     labelnames=("path",))
 _ROUTE_PALLAS = _ROUTE_TOTAL.labels(path="pallas")
 _ROUTE_REFERENCE = _ROUTE_TOTAL.labels(path="reference")
+# a tp>1 shard ctx forces the reference path even on TPU: pallas_call
+# is opaque to GSPMD (it would gather the full pool per device and
+# compute every head), while the reference gather/einsum partitions
+# along the sharded head axis for free.  A shard_map'd kernel over the
+# local head shard is the recorded remainder.
+_ROUTE_REFERENCE_TP = _ROUTE_TOTAL.labels(path="reference_tp")
 
 
 def paged_gather(pool, block_table):
@@ -302,7 +308,7 @@ def _paged_verify_pallas(q, k_pool, v_pool, block_table, pos0,
 
 
 def paged_verify_attention(q, k_pool, v_pool, block_table, pos0,
-                           scale: Optional[float] = None):
+                           scale: Optional[float] = None, shard=None):
     """softmax(q . K_table^T) V_table for a CHUNK of W query tokens
     per slot — the speculative verification read: query row j of slot
     b is the j-th token of the verified chunk, at position
@@ -315,14 +321,18 @@ def paged_verify_attention(q, k_pool, v_pool, block_table, pos0,
     :func:`paged_decode_attention`; ``pos0`` [B] int32.  Routes to the
     multi-query Pallas kernel on TPU, else to the per-row-unrolled
     reference — the byte-parity path the speculative greedy-parity
-    tests pin (CPU tier-1 always exercises it)."""
+    tests pin (CPU tier-1 always exercises it).  ``shard`` (a
+    ``TpShardCtx`` with ``tp > 1``) also forces the reference path:
+    its gathers/einsums partition along the sharded head axis, where
+    the Pallas call is opaque to GSPMD."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    if _route() == "pallas":
+    tp_forced = shard is not None and shard.tp > 1
+    if _route() == "pallas" and not tp_forced:
         _ROUTE_PALLAS.inc()
         return _paged_verify_pallas(q, k_pool, v_pool, block_table,
                                     pos0, float(scale))
-    _ROUTE_REFERENCE.inc()
+    (_ROUTE_REFERENCE_TP if tp_forced else _ROUTE_REFERENCE).inc()
     return paged_verify_attention_reference(q, k_pool, v_pool,
                                             block_table, pos0,
                                             float(scale))
@@ -341,7 +351,7 @@ def _route() -> str:
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_table, pos,
-                           scale: Optional[float] = None):
+                           scale: Optional[float] = None, shard=None):
     """softmax(q . K_table^T) V_table for ONE query token per slot.
 
     ``q`` [B, h, dh] — the just-written token's query per slot;
@@ -350,14 +360,16 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, pos,
     [B, max_blocks] int32; ``pos`` [B] int32 — attend over positions
     <= pos (the row written this tick included).  Routes to the Pallas
     kernel on TPU, else to the gather-based reference (the byte-parity
-    path CPU tier-1 exercises)."""
+    path CPU tier-1 exercises).  ``shard`` with ``tp > 1`` forces the
+    reference path (see :func:`paged_verify_attention`)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    if _route() == "pallas":
+    tp_forced = shard is not None and shard.tp > 1
+    if _route() == "pallas" and not tp_forced:
         _ROUTE_PALLAS.inc()
         return _paged_decode_pallas(q, k_pool, v_pool, block_table,
                                     pos, float(scale))
-    _ROUTE_REFERENCE.inc()
+    (_ROUTE_REFERENCE_TP if tp_forced else _ROUTE_REFERENCE).inc()
     return paged_decode_attention_reference(q, k_pool, v_pool,
                                             block_table, pos,
                                             float(scale))

@@ -125,7 +125,8 @@ def _block_decode_step(ly: TransformerEncoderBlock, params, kcache,
 
 
 def _block_decode_step_paged(ly: TransformerEncoderBlock, params,
-                             kpool, vpool, x, pos, table, wblk, woff):
+                             kpool, vpool, x, pos, table, wblk, woff,
+                             shard=None):
     """Paged-cache variant of ``_block_decode_step``: the slot's K/V
     live in pool blocks routed by a block table instead of a
     contiguous stripe.  x: [b, d] new-token hidden; ``kpool``/``vpool``
@@ -135,8 +136,17 @@ def _block_decode_step_paged(ly: TransformerEncoderBlock, params,
     reads THROUGH the table (``kernels.paged_decode_attention``; the
     reference path mirrors the stripe step's f32-score/-1e9-mask math
     exactly, which is what byte parity with offline decode rests on).
-    Returns (y [b, d], kpool, vpool)."""
+
+    ``shard`` (a ``parallel.mesh.TpShardCtx``, or None = identity) is
+    the mesh-sharded tick's parity contract: weights arrive with their
+    OUTPUT columns sharded along ``tp`` (heads ride along when qkv
+    splits), and ``shard.rep`` gathers the feature axis back to full
+    replication at EXACTLY the points where the math reduces over it —
+    before ``@ Wo``, both layer norms, and ``@ W2`` — so no device
+    ever sums a partial feature axis.  Returns (y [b, d], kpool,
+    vpool)."""
     from deeplearning4j_tpu.kernels import paged_decode_attention
+    rep = shard.rep if shard is not None else (lambda t: t)
     b, d = x.shape
     h, dh = ly.n_heads, d // ly.n_heads
     cast = lambda w: w.astype(x.dtype)
@@ -149,21 +159,24 @@ def _block_decode_step_paged(ly: TransformerEncoderBlock, params,
     vpool = vpool.at[wblk, :, woff, :].set(v)
 
     att = paged_decode_attention(q, kpool, vpool, table, pos,
-                                 scale=1.0 / (dh ** 0.5))
-    att = att.reshape(b, d)
+                                 scale=1.0 / (dh ** 0.5), shard=shard)
+    att = rep(att.reshape(b, d))
     att = att @ cast(params["Wo"]) + cast(params["bo"])
-    hdn = _layer_norm(x + att, params["ln1_g"], params["ln1_b"], ly.eps)
+    hdn = _layer_norm(rep(x + att), params["ln1_g"], params["ln1_b"],
+                      ly.eps)
 
     from deeplearning4j_tpu.nn.activations import get_activation
     act = get_activation(ly.activation or "gelu")
     ffn = act(hdn @ cast(params["W1"]) + cast(params["b1"]))
-    ffn = ffn @ cast(params["W2"]) + cast(params["b2"])
-    y = _layer_norm(hdn + ffn, params["ln2_g"], params["ln2_b"], ly.eps)
+    ffn = rep(ffn) @ cast(params["W2"]) + cast(params["b2"])
+    y = _layer_norm(rep(hdn + ffn), params["ln2_g"], params["ln2_b"],
+                    ly.eps)
     return y, kpool, vpool
 
 
 def _block_verify_step_paged(ly: TransformerEncoderBlock, params,
-                             kpool, vpool, x, table, wblk, woff, pos0):
+                             kpool, vpool, x, table, wblk, woff, pos0,
+                             shard=None):
     """W-token verification step for speculative decode: one block's
     forward over a chunk of W tokens per slot, K/V written through the
     block table at (``wblk``, ``woff``) [B, W] and attention read back
@@ -177,7 +190,11 @@ def _block_verify_step_paged(ly: TransformerEncoderBlock, params,
     per query row inside the kernel's reference path.  Together that
     makes this chunked step's outputs AND cache writes byte-identical
     to W sequential ``_block_decode_step_paged`` ticks — the invariant
-    speculative greedy parity rests on."""
+    speculative greedy parity rests on.  ``shard`` replicates feature
+    axes before their reductions exactly as in
+    ``_block_decode_step_paged`` (the flat [B*W, d] rows keep their
+    batch axis on ``data``)."""
+    rep = shard.rep if shard is not None else (lambda t: t)
     BW, d = x.shape
     B, W = wblk.shape
     h, dh = ly.n_heads, d // ly.n_heads
@@ -192,16 +209,18 @@ def _block_verify_step_paged(ly: TransformerEncoderBlock, params,
     vpool = vpool.at[wblk, :, woff, :].set(v)
 
     att = paged_verify_attention(q, kpool, vpool, table, pos0,
-                                 scale=1.0 / (dh ** 0.5))
-    att = att.reshape(BW, d)
+                                 scale=1.0 / (dh ** 0.5), shard=shard)
+    att = rep(att.reshape(BW, d))
     att = att @ cast(params["Wo"]) + cast(params["bo"])
-    hdn = _layer_norm(x + att, params["ln1_g"], params["ln1_b"], ly.eps)
+    hdn = _layer_norm(rep(x + att), params["ln1_g"], params["ln1_b"],
+                      ly.eps)
 
     from deeplearning4j_tpu.nn.activations import get_activation
     act = get_activation(ly.activation or "gelu")
     ffn = act(hdn @ cast(params["W1"]) + cast(params["b1"]))
-    ffn = ffn @ cast(params["W2"]) + cast(params["b2"])
-    y = _layer_norm(hdn + ffn, params["ln2_g"], params["ln2_b"], ly.eps)
+    ffn = rep(ffn) @ cast(params["W2"]) + cast(params["b2"])
+    y = _layer_norm(rep(hdn + ffn), params["ln2_g"], params["ln2_b"],
+                    ly.eps)
     return y, kpool, vpool
 
 
@@ -215,12 +234,15 @@ def _embed_prompt(ly: EmbeddingSequenceLayer, params, ids):
     return y
 
 
-def _block_prefill(ly: TransformerEncoderBlock, params, x):
+def _block_prefill(ly: TransformerEncoderBlock, params, x, shard=None):
     """Whole-prompt causal forward for one block: x [b, t, d] ->
     (y [b, t, d], k [b, h, t, dh], v) — ONE batched pass instead of t
     cached single-token steps, so prefill runs at matmul rate instead
     of the per-step params-bandwidth floor.  Same math (f32 scores,
-    -1e9 mask) as ``_block_decode_step``."""
+    -1e9 mask) as ``_block_decode_step``.  ``shard`` replicates the
+    feature axis before its reductions (mesh-sharded admissions; the
+    returned K/V rows stay head-sharded for the pool scatter)."""
+    rep = shard.rep if shard is not None else (lambda t: t)
     b, t, d = x.shape
     h, dh = ly.n_heads, d // ly.n_heads
     cast = lambda w: w.astype(x.dtype)
@@ -235,19 +257,21 @@ def _block_prefill(ly: TransformerEncoderBlock, params, x):
     s = jnp.where((cols <= rows)[None, None], s, -1e9)
     p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
     att = jnp.einsum("bhqk,bhkd->bhqd", p, v)
-    att = att.transpose(0, 2, 1, 3).reshape(b, t, d)
+    att = rep(att.transpose(0, 2, 1, 3).reshape(b, t, d))
     att = att @ cast(params["Wo"]) + cast(params["bo"])
-    hdn = _layer_norm(x + att, params["ln1_g"], params["ln1_b"], ly.eps)
+    hdn = _layer_norm(rep(x + att), params["ln1_g"], params["ln1_b"],
+                      ly.eps)
     from deeplearning4j_tpu.nn.activations import get_activation
     act = get_activation(ly.activation or "gelu")
     ffn = act(hdn @ cast(params["W1"]) + cast(params["b1"]))
-    ffn = ffn @ cast(params["W2"]) + cast(params["b2"])
-    y = _layer_norm(hdn + ffn, params["ln2_g"], params["ln2_b"], ly.eps)
+    ffn = rep(ffn) @ cast(params["W2"]) + cast(params["b2"])
+    y = _layer_norm(rep(hdn + ffn), params["ln2_g"], params["ln2_b"],
+                    ly.eps)
     return y, k, v
 
 
 def _block_prefill_chunked(ly: TransformerEncoderBlock, params, x,
-                           pk, pv, p0):
+                           pk, pv, p0, shard=None):
     """Chunked (suffix) causal forward for one block: the query rows
     are the UNCACHED prompt suffix at global positions p0..p0+s-1 and
     the key set is [cached prefix K/V ; suffix K/V].  x: [b, s, d];
@@ -257,7 +281,10 @@ def _block_prefill_chunked(ly: TransformerEncoderBlock, params, x,
     contribute EXACT zeros to the softmax, so the suffix rows come out
     byte-identical to the full-prompt prefill's — the prefix-cache hit
     path's parity contract.  Returns (y, k, v) with k/v the SUFFIX
-    rows only."""
+    rows only.  ``shard`` replicates feature axes before their
+    reductions (the gathered prefix K/V arrive head-sharded from the
+    mesh-sharded pool and concatenate exactly)."""
+    rep = shard.rep if shard is not None else (lambda t: t)
     b, s_len, d = x.shape
     h, dh = ly.n_heads, d // ly.n_heads
     cast = lambda w: w.astype(x.dtype)
@@ -278,14 +305,16 @@ def _block_prefill_chunked(ly: TransformerEncoderBlock, params, x,
     s = jnp.where(mask[None, None], s, -1e9)
     p = jax.nn.softmax(s, axis=-1).astype(vv.dtype)
     att = jnp.einsum("bhqk,bhkd->bhqd", p, vv)
-    att = att.transpose(0, 2, 1, 3).reshape(b, s_len, d)
+    att = rep(att.transpose(0, 2, 1, 3).reshape(b, s_len, d))
     att = att @ cast(params["Wo"]) + cast(params["bo"])
-    hdn = _layer_norm(x + att, params["ln1_g"], params["ln1_b"], ly.eps)
+    hdn = _layer_norm(rep(x + att), params["ln1_g"], params["ln1_b"],
+                      ly.eps)
     from deeplearning4j_tpu.nn.activations import get_activation
     act = get_activation(ly.activation or "gelu")
     ffn = act(hdn @ cast(params["W1"]) + cast(params["b1"]))
-    ffn = ffn @ cast(params["W2"]) + cast(params["b2"])
-    y = _layer_norm(hdn + ffn, params["ln2_g"], params["ln2_b"], ly.eps)
+    ffn = rep(ffn) @ cast(params["W2"]) + cast(params["b2"])
+    y = _layer_norm(rep(hdn + ffn), params["ln2_g"], params["ln2_b"],
+                    ly.eps)
     return y, k, v
 
 
@@ -417,29 +446,40 @@ class TransformerGenerator:
         return logits, kc, vc
 
     def _step_paged(self, emb_p, blk_stack, head_p, kc, vc, tok, pos,
-                    table, wblk, woff):
+                    table, wblk, woff, shard=None):
         """Paged-pool decode tick: ``kc``/``vc`` are the global block
         pools [n_layers, n_blocks, h, block_size, dh], ``table``
         [b, max_blocks] the per-slot block tables, and the new row
         lands at (``wblk``, ``woff``) per slot.  Same layer-scan
         structure as ``_step``; attention routes through
-        ``kernels.paged_decode_attention``."""
+        ``kernels.paged_decode_attention``.  ``shard`` (TpShardCtx)
+        turns this into the mesh-sharded tick: embeds replicate, block
+        math shards heads/columns along ``tp`` with explicit
+        replication before feature reductions, and the logits gather
+        so the sampler's argmax/sort runs on the full vocab row —
+        byte-identical to the unsharded program by construction."""
         x = _embed_token(self.emb, emb_p, tok, pos)
         x = x.astype(self.compute_dtype)
+        if shard is not None:
+            x = shard.rep(x)
         ly = self.blocks[0]          # conf-identical (checked in init)
 
         def body(h, layer):
             p, kc_l, vc_l = layer
             h, kc_l, vc_l = _block_decode_step_paged(
-                ly, p, kc_l, vc_l, h, pos, table, wblk, woff)
+                ly, p, kc_l, vc_l, h, pos, table, wblk, woff,
+                shard=shard)
             return h, (kc_l, vc_l)
 
         x, (kc, vc) = jax.lax.scan(body, x, (blk_stack, kc, vc))
         logits = (x.astype(jnp.float32) @ head_p["W"] + head_p["b"])
+        if shard is not None:
+            logits = shard.rep(logits)
         return logits, kc, vc
 
     def _verify_rows_paged(self, emb_p, blk_stack, head_p, kc, vc,
-                           toks, pos0, epos, table, wblk, woff):
+                           toks, pos0, epos, table, wblk, woff,
+                           shard=None):
         """Speculative verification forward: ONE batched pass over a
         chunk of W tokens per slot — ``toks`` [B, W] (the anchor + the
         draft's proposals, inactive rows masked to 0), ``pos0`` [B]
@@ -465,19 +505,24 @@ class TransformerGenerator:
         if self.emb.layer_norm:
             y = _layer_norm(y, emb_p["g"], emb_p["b"], self.emb.eps)
         x = y.astype(self.compute_dtype)
+        if shard is not None:
+            x = shard.rep(x)
 
         def body(h, layer):
             p, kc_l, vc_l = layer
             h, kc_l, vc_l = _block_verify_step_paged(
-                ly, p, kc_l, vc_l, h, table, wblk, woff, pos0)
+                ly, p, kc_l, vc_l, h, table, wblk, woff, pos0,
+                shard=shard)
             return h, (kc_l, vc_l)
 
         x, (kc, vc) = jax.lax.scan(body, x, (blk_stack, kc, vc))
         logits = (x.astype(jnp.float32) @ head_p["W"] + head_p["b"])
+        if shard is not None:
+            logits = shard.rep(logits)
         return logits.reshape(B, W, -1), kc, vc
 
     def _prefill_rows_chunked(self, emb_p, blk_stack, head_p, suffix,
-                              pk, pv, p0, last_ix):
+                              pk, pv, p0, last_ix, shard=None):
         """Chunked-prefill counterpart of ``_prefill_rows`` for
         prefix-cache HITS: ``suffix`` [b, s] are the uncached prompt
         tokens at global positions p0..p0+s-1 (pad tail beyond the
@@ -498,16 +543,20 @@ class TransformerGenerator:
         if self.emb.layer_norm:
             y = _layer_norm(y, emb_p["g"], emb_p["b"], self.emb.eps)
         x = y.astype(cd)
+        if shard is not None:
+            x = shard.rep(x)
 
         def body(hdn, layer):
             p, pk_l, pv_l = layer
             hdn, k, v = _block_prefill_chunked(ly, p, hdn, pk_l, pv_l,
-                                               p0)
+                                               p0, shard=shard)
             return hdn, (k.astype(cd), v.astype(cd))
 
         x, (ks, vs) = jax.lax.scan(body, x, (blk_stack, pk, pv))
         last = jax.lax.dynamic_slice_in_dim(x, last_ix, 1, axis=1)[:, 0]
         logits = last.astype(jnp.float32) @ head_p["W"] + head_p["b"]
+        if shard is not None:
+            logits = shard.rep(logits)
         return logits, ks, vs
 
     def generate(self, prompt_ids, n_new: int, temperature: float = 0.0,
@@ -565,7 +614,8 @@ class TransformerGenerator:
             _GEN_RATE.set(n_new / dt)
         return out
 
-    def _prefill_rows(self, emb_p, blk_stack, head_p, prompt, t0=None):
+    def _prefill_rows(self, emb_p, blk_stack, head_p, prompt, t0=None,
+                      shard=None):
         """Batched prompt pass scanned over the stacked block params.
         Returns (logits [b, V], ks, vs [n_layers, b, h, t, dh]) — the
         raw per-layer K/V rows, for the caller to place (offline decode
@@ -579,9 +629,11 @@ class TransformerGenerator:
         ly = self.blocks[0]
         x = _embed_prompt(self.emb, emb_p, prompt)
         x = x.astype(cd)
+        if shard is not None:
+            x = shard.rep(x)
 
         def body(hdn, p):
-            hdn, k, v = _block_prefill(ly, p, hdn)
+            hdn, k, v = _block_prefill(ly, p, hdn, shard=shard)
             return hdn, (k.astype(cd), v.astype(cd))
 
         x, (ks, vs) = jax.lax.scan(body, x, blk_stack)
@@ -591,6 +643,8 @@ class TransformerGenerator:
             last = jax.lax.dynamic_slice_in_dim(x, t0 - 1, 1,
                                                 axis=1)[:, 0]
         logits = last.astype(jnp.float32) @ head_p["W"] + head_p["b"]
+        if shard is not None:
+            logits = shard.rep(logits)
         return logits, ks, vs
 
     def _prefill(self, emb_p, blk_stack, head_p, prompt, L):

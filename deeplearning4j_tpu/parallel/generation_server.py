@@ -147,7 +147,21 @@ bytes), and ``import_blocks`` lands them in the target replica's tier,
 where the handed-off request's admission restores them exactly like a
 tier hit and re-registers them device-resident for copy-free reuse.
 
-Not here yet (ROADMAP open items): a TP/mesh-sharded tick.
+MESH-SHARDED tick (``devices=``, ISSUE 17): an explicit device slice
+turns every dispatch into ONE GSPMD program over a ``("data", "tp")``
+mesh (``parallel.mesh.serving_mesh`` + ``TpShardCtx``) — attention
+heads and qkv/mlp/vocab OUTPUT columns shard along ``tp``, per-slot
+state and block tables along ``data`` — so one replica serves params
+N× too big for a single chip's HBM.  Byte parity is by construction,
+not by tolerance: no contracting dimension is ever sharded, and the
+decode/verify/prefill bodies gather to full replication immediately
+before every feature-axis reduction (``TpShardCtx.rep``), so
+cross-chip traffic is exact data movement and tp=2 greedy output is
+bitwise tp=1 output.  ``tp > 1`` routes paged attention through the
+reference path (``pallas_call`` is opaque to GSPMD; a ``shard_map``'d
+local-head kernel is a ROADMAP remainder).  ``devices=None`` (the
+default) never builds a shard ctx — the single-device program is the
+exact pre-mesh jaxpr.
 """
 from __future__ import annotations
 
@@ -174,6 +188,7 @@ from deeplearning4j_tpu.models.generation import (TransformerGenerator,
                                                   _filter_logits_rows)
 from deeplearning4j_tpu.parallel import speculative as _speculative
 from deeplearning4j_tpu.parallel.kv_tiering import HostKVTier
+from deeplearning4j_tpu.parallel.mesh import TpShardCtx, serving_mesh
 from deeplearning4j_tpu.parallel.inference import _bucket
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience.errors import (CancelledError,
@@ -354,6 +369,14 @@ _SPEC_ACCEPT_RATE = telemetry.gauge(
     "generation_server_spec_acceptance_rate",
     "cumulative accepted/proposed draft-token ratio of the most "
     "recently dispatching speculative server")
+# Mesh-sharded serving (ISSUE 17): the tp degree of the most recently
+# constructed server — 1 means single-device; N means params + KV
+# heads spread over an N-chip slice (the per-replica split lives in
+# fleet_replica_devices{replica=} on the router side).
+_TP_DEGREE = telemetry.gauge(
+    "generation_server_tp_degree",
+    "tensor-parallel degree of the most recently constructed server "
+    "(chips one replica's params/KV-head shards span; 1 = unsharded)")
 # Replica-side half of the request-phase family (the fleet router owns
 # the admission/placement/total phases): the SAME spans that build a
 # request's trace tree observe these series, so TTFT decomposes into
@@ -549,6 +572,17 @@ class GenerationServer:
     win is committed tokens per expensive target pass (up to k+1),
     paid for with ~2x blocks per admission (the draft's table).
 
+    ``devices`` pins the server to an EXPLICIT device slice and — with
+    more than one device — mesh-shards the replica across it
+    (ISSUE 17): ``tp`` (default: the whole slice) chips hold the
+    head/output-column shards of the params and the KV block pool,
+    ``len(devices) // tp`` becomes the ``data`` axis sharding per-slot
+    state and block tables.  Greedy output stays byte-identical to a
+    single-device server (see the module docstring); ``n_heads`` must
+    divide by ``tp`` and ``n_slots`` by the data extent.  CPU CI
+    exercises this with ``XLA_FLAGS=--xla_force_host_platform_
+    device_count=N`` virtual devices.
+
     Resilience knobs: ``tick_timeout_s`` arms the watchdog (None
     disables it; the stuck-tick deadline scales by the in-flight scan
     length — a K-tick scan legitimately runs ~K x longer);
@@ -569,6 +603,8 @@ class GenerationServer:
                  prefix_cache: bool = True,
                  host_tier_blocks: int = 0,
                  speculative: Optional[dict] = None,
+                 devices=None,
+                 tp: Optional[int] = None,
                  queue_limit: int = 1024,
                  tick_timeout_s: Optional[float] = 30.0,
                  request_deadline_s: Optional[float] = None,
@@ -648,6 +684,36 @@ class GenerationServer:
                                    if request_deadline_s else None)
         self.submit_retries = int(submit_retries)
         self.retry_backoff_s = float(retry_backoff_s)
+
+        # Mesh-sharded replica (ISSUE 17): an explicit device slice
+        # builds the ("data", "tp") shard ctx every dispatch below
+        # threads through the decode/verify/prefill bodies.  A
+        # one-device slice still gets a ctx — it PINS the replica to
+        # that device (a fleet mixing single- and multi-chip replicas
+        # hands each its own slice) — but tp=1 keeps the pallas route
+        # and the constraints are no-ops on a 1-extent mesh.
+        self._shard = None
+        if devices is not None:
+            ctx = TpShardCtx(serving_mesh(devices, tp))
+            h = gen.blocks[0].n_heads
+            if h % ctx.tp:
+                raise ValueError(
+                    f"n_heads={h} must divide by tp={ctx.tp} (the KV "
+                    "pool's head axis is the tp shard)")
+            if self._spec is not None:
+                self._spec.draft.check_tp(ctx.tp)
+            if self.n_slots % ctx.data:
+                raise ValueError(
+                    f"n_slots={self.n_slots} must divide by the mesh "
+                    f"data axis ({ctx.data}) to shard per-slot state")
+            self._shard = ctx
+        self.tp_degree = self._shard.tp if self._shard else 1
+        #: per-device "platform:id" labels of the slice (profiler
+        #: phase attribution); None = the profiler's default device
+        self._device_labels = (
+            [f"{d.platform}:{d.id}" for d in self._shard.devices]
+            if self._shard is not None else None)
+        _TP_DEGREE.set(self.tp_degree)
 
         # Scheduler state shared with the watchdog: _active/_pending/
         # _free and the device pool (_kc/_vc/_state) mutate only under
@@ -734,6 +800,15 @@ class GenerationServer:
                                      # scratch sink for masked writes
         kc = jnp.zeros((n_layers, nb, h, self.block_size, dh), cd)
         vc = jnp.zeros((n_layers, nb, h, self.block_size, dh), cd)
+        if self._shard is not None:
+            # pool HEADS shard along tp (each chip holds its head
+            # slice of every block); the block axis stays GLOBAL —
+            # blocks are one pool shared across slots and the host
+            # allocator/free list is the single truth the autoscaler
+            # reads (a data-sharded pool/allocator is a ROADMAP
+            # remainder).  Per-slot state rows shard along data.
+            kc = self._shard.put(kc, None, None, "tp", None, None)
+            vc = self._shard.put(vc, None, None, "tp", None, None)
         state = {
             "pos": jnp.zeros((B,), jnp.int32),        # next write index
             "remaining": jnp.zeros((B,), jnp.int32),  # tokens to emit
@@ -755,6 +830,9 @@ class GenerationServer:
             # pool leaves under these block ids)
             "dtable": jnp.zeros((B, self.max_blocks), jnp.int32),
         }
+        if self._shard is not None:
+            state = {k: self._shard.put_batch(v)
+                     for k, v in state.items()}
         # commit atomically: this also runs on the watchdog's recovery
         # path while the (fenced) scheduler may still be snapshotting.
         # The host allocator truth resets WITH the device pool — free
@@ -792,6 +870,9 @@ class GenerationServer:
                            else a), t)
             emb_p, blk_stack, head_p = (cast(emb_p), cast(blk_stack),
                                         cast(head_p))
+        if self._shard is not None:
+            emb_p, blk_stack, head_p = self._place_params(
+                emb_p, blk_stack, head_p)
         self._params = (emb_p, blk_stack, head_p)
         if self._spec is not None:
             # the draft refreshes WITH the target (a self-draft
@@ -799,6 +880,49 @@ class GenerationServer:
             # in-trace, zero extra device memory; an external draft
             # re-snapshots its own net)
             self._draft_params = self._spec.draft.params(self._params)
+            if self._shard is not None:
+                # self-draft leaves are already placed (device_put at
+                # an identical sharding is the identity); an external
+                # draft's own snapshot spreads here
+                self._draft_params = self._place_params(
+                    *self._draft_params)
+
+    #: output-axis shard map for the stacked block params (ISSUE 17):
+    #: every named axis is an OUTPUT axis — qkv/mlp columns — so no
+    #: contraction is ever split (the TpShardCtx parity contract);
+    #: everything absent (layer norms) replicates.
+    _BLK_SHARD_AXES = {
+        "Wqkv": (None, None, "tp"), "bqkv": (None, "tp"),
+        "Wo": (None, None, "tp"), "bo": (None, "tp"),
+        "W1": (None, None, "tp"), "b1": (None, "tp"),
+        "W2": (None, None, "tp"), "b2": (None, "tp"),
+    }
+
+    def _place_params(self, emb_p, blk_stack, head_p):
+        """Spread one serving snapshot over the replica's mesh: block
+        weights by :attr:`_BLK_SHARD_AXES`, the embedding/positional
+        tables by their vocab/position ROWS (gathered by token id —
+        pure data movement), the head by its vocab columns.  ``put``
+        falls any axis the tp extent does not divide back to
+        replication, so odd vocab sizes etc. cost memory, never
+        parity."""
+        shard = self._shard
+        emb_p = dict(emb_p)
+        for k, axes in (("W", ("tp", None)), ("P", ("tp", None))):
+            if k in emb_p:
+                emb_p[k] = shard.put(emb_p[k], *axes)
+        for k in ("g", "b"):
+            if k in emb_p:
+                emb_p[k] = shard.put(emb_p[k])
+        blk_stack = {
+            k: shard.put(v, *self._BLK_SHARD_AXES.get(k, ()))
+            for k, v in blk_stack.items()}
+        head_p = dict(head_p)
+        if "W" in head_p:
+            head_p["W"] = shard.put(head_p["W"], None, "tp")
+        if "b" in head_p:
+            head_p["b"] = shard.put(head_p["b"], "tp")
+        return emb_p, blk_stack, head_p
 
     def healthy(self) -> bool:
         """True while the scheduler thread is alive and admission is
@@ -862,6 +986,15 @@ class GenerationServer:
                 "spec_acceptance_rate": (
                     self._n_spec_accepted / self._n_spec_proposed
                     if self._n_spec_proposed else 0.0),
+                # mesh view (ISSUE 17): the slice THIS replica spans.
+                # free_blocks above is already the GLOBAL pool truth —
+                # the host allocator is unsharded (the pool's block
+                # axis is global; only its head axis shards), so an
+                # autoscaler reads one number, not per-shard counts.
+                "tp": self.tp_degree,
+                "devices": (list(self._device_labels)
+                            if self._device_labels is not None
+                            else None),
             }
 
     def prefix_warmth(self, prompt_ids) -> int:
@@ -1469,6 +1602,7 @@ class GenerationServer:
         gen = self._gen
         pick = self._sampler(sampled)
         bs = self.block_size
+        shard = self._shard
 
         def scan_fn(emb_p, blk_stack, head_p, kc, vc, state):
             def step(carry, _):
@@ -1488,7 +1622,7 @@ class GenerationServer:
                 woff = jnp.where(active, pos % bs, 0)
                 new_logits, kc, vc = gen._step_paged(
                     emb_p, blk_stack, head_p, kc, vc, tok, pos, tbl,
-                    wblk, woff)
+                    wblk, woff, shard=shard)
                 hit_eos = active & (tok == state["eos"])
                 remaining = jnp.where(active, state["remaining"] - 1, 0)
                 remaining = jnp.where(hit_eos, 0, remaining)
@@ -1559,6 +1693,7 @@ class GenerationServer:
         W = K + 1
         bs = self.block_size
         B = self.n_slots
+        shard = self._shard
 
         def spec_fn(emb_p, blk_stack, head_p, demb_p, dblk, dhead_p,
                     kc, vc, state):
@@ -1600,7 +1735,7 @@ class GenerationServer:
                     woff = jnp.where(ok, p % bs, 0)
                     lg, kcd, vcd = dgen._step_paged(
                         demb_p, dblk, dhead_p, kcd, vcd, tok, p,
-                        dtbl, wblk, woff)
+                        dtbl, wblk, woff, shard=shard)
                     nxt = jnp.where(ok, jnp.argmax(lg, axis=-1),
                                     0).astype(jnp.int32)
                     return (kcd, vcd, nxt), tok
@@ -1623,7 +1758,7 @@ class GenerationServer:
                 pos0 = jnp.where(active, pos, 0)
                 G, kc, vc = gen._verify_rows_paged(
                     emb_p, blk_stack, head_p, kc, vc, vtok, pos0,
-                    epos, tbl, wblk, woff)
+                    epos, tbl, wblk, woff, shard=shard)
                 g = jnp.argmax(G, axis=-1).astype(jnp.int32)
                 c, rem_after = _speculative.accept_greedy(
                     v, g, active, rem, state["eos"])
@@ -1731,6 +1866,7 @@ class GenerationServer:
             return self._admit_cache[key]
         gen = self._gen
         spec = self._spec if use_draft else None
+        shard = self._shard
 
         def admit(emb_p, blk_stack, head_p, kc, vc, state, prompt, t0,
                   slot, n_new, eos_id, key, temp, tk, tp, phys,
@@ -1738,7 +1874,8 @@ class GenerationServer:
             # t0 picks the last REAL position's logits out of the
             # padded bucket
             logits, ks, vs = gen._prefill_rows(emb_p, blk_stack,
-                                               head_p, prompt, t0)
+                                               head_p, prompt, t0,
+                                               shard=shard)
             kc = self._scatter_rows(kc, ks, phys)
             vc = self._scatter_rows(vc, vs, phys)
             if spec is not None:
@@ -1751,7 +1888,7 @@ class GenerationServer:
                 dblk = jax.tree_util.tree_map(
                     lambda a: a[:spec.draft.n_layers], dblk)
                 _, dks, dvs = spec.draft.gen._prefill_rows(
-                    demb_p, dblk, dhead_p, prompt, t0)
+                    demb_p, dblk, dhead_p, prompt, t0, shard=shard)
                 kc = self._scatter_rows(kc, dks, dphys)
                 vc = self._scatter_rows(vc, dvs, dphys)
             state = self._arm_slot(state, logits, slot, t0, n_new,
@@ -1793,6 +1930,7 @@ class GenerationServer:
             return self._admit_cache[key]
         gen = self._gen
         spec = self._spec if use_draft else None
+        shard = self._shard
 
         def admit(emb_p, blk_stack, head_p, kc, vc, state, suffix, p0,
                   last_ix, t0, slot, n_new, eos_id, key, temp, tk, tp,
@@ -1815,7 +1953,8 @@ class GenerationServer:
                 .reshape(nl, 1, h, matched * bs, dh)
             pk, pv = gather(kc), gather(vc)
             logits, ks, vs = gen._prefill_rows_chunked(
-                emb_p, blk_stack, head_p, suffix, pk, pv, p0, last_ix)
+                emb_p, blk_stack, head_p, suffix, pk, pv, p0, last_ix,
+                shard=shard)
             kc = self._scatter_rows(kc, ks, phys)
             vc = self._scatter_rows(vc, vs, phys)
             if spec is not None:
@@ -1823,7 +1962,7 @@ class GenerationServer:
                 dblk = jax.tree_util.tree_map(
                     lambda a: a[:spec.draft.n_layers], dblk)
                 _, dks, dvs = spec.draft.gen._prefill_rows(
-                    demb_p, dblk, dhead_p, dprompt, t0)
+                    demb_p, dblk, dhead_p, dprompt, t0, shard=shard)
                 kc = self._scatter_rows(kc, dks, dphys)
                 vc = self._scatter_rows(vc, dvs, dphys)
             state = self._arm_slot(state, logits, slot, t0, n_new,
@@ -1879,7 +2018,8 @@ class GenerationServer:
         # 1-in-N sampled calls (explicit every=, NOT the profiler's
         # default of 1), so unsampled admissions stay fully async
         with telemetry.get_profiler().measure(
-                "prefill", every=_PROFILE_PREFILL_EVERY) as prof_m:
+                "prefill", every=_PROFILE_PREFILL_EVERY,
+                devices=self._device_labels) as prof_m:
             if matched:
                 # prefix HIT: gather the cached blocks, prefill only
                 # the suffix — scatter targets start at the first
@@ -2514,7 +2654,8 @@ class GenerationServer:
                     # site already syncs (the np.asarray poll), so the
                     # continuous profile costs one perf_counter pair
                     with prof.measure("verify" if use_spec
-                                      else "decode_tick"):
+                                      else "decode_tick",
+                                      devices=self._device_labels):
                         if use_spec:
                             demb_p, dblk, dhead_p = self._draft_params
                             (kc, vc, state, toks, emitted, n_alive,
@@ -2669,6 +2810,17 @@ class GenerationServer:
                 _TICK_FAILURES.inc()
                 _FLIGHT.record("tick_failure",
                                error=type(e).__name__)
+                if self.tp_degree > 1:
+                    # a multi-chip replica's failed dispatch is, from
+                    # the host, indistinguishable from losing one chip
+                    # of the tp group mid-tick — record the mesh-loss
+                    # event the chaos drill (and a postmortem bundle)
+                    # keys on, with the slice it spanned
+                    _FLIGHT.record("tp_device_loss",
+                                   tp=self.tp_degree,
+                                   devices=",".join(
+                                       self._device_labels or ()),
+                                   error=type(e).__name__)
                 err = RetryableServerError(
                     "decode dispatch failed and the slot pool was "
                     "rebuilt; the request was not applied — safe to "
@@ -2734,6 +2886,14 @@ class GenerationServer:
         _WATCHDOG_RESTARTS.inc()
         _FLIGHT.record("watchdog", reason=reason,
                        epoch=int(new_epoch))
+        if self.tp_degree > 1:
+            # stuck/dead dispatch on a multi-chip replica: same
+            # mesh-loss event as the inline path — a hung collective
+            # after losing a tp peer lands HERE, not in the inline
+            # except (the dispatch never returns)
+            _FLIGHT.record("tp_device_loss", tp=self.tp_degree,
+                           devices=",".join(self._device_labels or ()),
+                           error="watchdog")
         # freeze the black box BEFORE the owner-death span flush and
         # the pool rebuild: the bundle must hold the hung dispatch's
         # still-open tick span and the pre-recovery ring — the "what

@@ -87,6 +87,20 @@ class DraftModel:
         refreshes the draft too."""
         return self._params_fn(target_params)
 
+    def check_tp(self, tp: int) -> None:
+        """Validate the draft's geometry against a mesh-sharded
+        replica's tp degree (ISSUE 17): the draft's K/V rows land in
+        the SAME head-sharded pool leaves the target's do, so its head
+        count must split the same way — a self-draft inherits the
+        target's heads and passes trivially, but an external draft
+        with an incompatible head count must fail at construction, not
+        as a GSPMD error mid-admission."""
+        h = self.gen.blocks[0].n_heads
+        if tp > 1 and h % tp:
+            raise ValueError(
+                f"draft n_heads={h} must divide by tp={tp} (draft KV "
+                "shares the head-sharded pool)")
+
 
 def make_self_draft(gen: TransformerGenerator,
                     draft_layers: Optional[int] = None) -> DraftModel:

@@ -70,11 +70,16 @@ the slot scheduler inside each ``GenerationServer``:
   EXISTING migration machinery — reclassified against the surviving
   topology, completing byte-identical either way.
 
-The fleet is in-process: replicas share the host and its device(s),
-which is the single-chip degenerate of the multi-host layout (each
-replica maps to one chip/pod-slice worker; the router's state is
-host-side dicts either way).  The mesh-sharded tick is the ROADMAP
-remainder this PR does not touch.
+The fleet is in-process: replicas share the host, but a replica no
+longer maps to at most one chip — ``devices=`` hands each replica its
+own (disjoint) device slice and the server lays its tick over a
+``data``/``tp`` mesh (ISSUE 17, ``parallel/mesh.py``), so ONE fleet
+mixes single-chip and multi-chip replicas.  The router stays
+placement-policy-only: a replica's span is invisible to admission,
+affinity and migration (a tp=2 victim's requests re-place
+byte-identically onto a single-chip survivor), and the per-replica
+``fleet_replica_devices{replica=}`` gauge is the only router-side
+trace of the topology.
 
 Telemetry: ``fleet_requests_total{tenant=,outcome=}`` (admitted /
 queued / rejected_quota / rejected_deadline / migrated — plus
@@ -148,6 +153,11 @@ _REPL_HEALTHY = telemetry.gauge(
     "fleet_replicas_healthy",
     "replicas currently dispatchable (healthy, not dead, not "
     "draining) — a fleet balancer's aggregate health signal")
+_REPL_DEVICES = telemetry.gauge(
+    "fleet_replica_devices",
+    "chips in each replica's device slice (ISSUE 17): 1 = single-chip "
+    "replica, N = a mesh-sharded replica spanning N chips as one tp "
+    "group", labelnames=("replica",))
 _FLEET_QDEPTH = telemetry.gauge(
     "fleet_queue_depth",
     "requests waiting in the fleet router (intake + quota/capacity "
@@ -319,7 +329,16 @@ class ServingFleet:
     replica — byte-identical to a unified decode, with the long
     prefill off the decode replicas' tick path.  Pass
     ``host_tier_blocks`` (a server kwarg) to also spill evicted
-    prefix blocks to host RAM on every replica.  Remaining
+    prefix blocks to host RAM on every replica.
+
+    ``devices`` (ISSUE 17) gives each replica its own DEVICE SLICE —
+    one entry per replica, ``None`` (default placement) or an
+    explicit device list the replica mesh-shards across as one tp
+    group (``GenerationServer(devices=...)``) — so one fleet mixes
+    single-chip and multi-chip replicas.  Slices must be disjoint.
+    The router itself stays placement-policy-only: affinity /
+    least-loaded / failover ranking never looks at what a replica
+    spans.  Remaining
     ``**server_kwargs`` construct the replicas (``speculative`` —
     draft-verified multi-token decode, whose per-replica acceptance
     rate surfaces through ``stats()`` — plus ``n_slots``,
@@ -335,6 +354,7 @@ class ServingFleet:
                  dead_after_s: float = 1.0,
                  queue_limit: int = 4096,
                  roles: Optional[Iterable[str]] = None,
+                 devices: Optional[Iterable] = None,
                  prefill_threshold: Optional[int] = None,
                  slo_engine=None,
                  **server_kwargs):
@@ -362,6 +382,34 @@ class ServingFleet:
                     "a prefill-only fleet cannot decode — at least "
                     "one replica needs role 'decode' or 'unified'")
         self._roles: List[str] = role_list
+        # per-replica device slices (ISSUE 17 mesh-sharded serving):
+        # one entry per replica — None (the process default device) or
+        # an explicit device list the replica mesh-shards across.  The
+        # router stays PLACEMENT-POLICY-ONLY: nothing downstream cares
+        # what a replica spans — slices only size the replicas and the
+        # fleet_replica_devices gauge.  Validated like roles, before
+        # any replica is constructed; overlapping slices double-book a
+        # chip's HBM and are refused.
+        if devices is None:
+            dev_list = [None] * self.n_replicas
+        else:
+            dev_list = [None if d is None else list(d) for d in devices]
+            if len(dev_list) != self.n_replicas:
+                raise ValueError(
+                    f"devices has {len(dev_list)} slices for "
+                    f"n_replicas={self.n_replicas}")
+            seen = {}
+            for i, slc in enumerate(dev_list):
+                for d in (slc or ()):
+                    key = (getattr(d, "platform", "?"),
+                           getattr(d, "id", id(d)))
+                    if key in seen:
+                        raise ValueError(
+                            f"device {key[0]}:{key[1]} appears in "
+                            f"replica {seen[key]}'s and replica "
+                            f"{i}'s slices — slices must be disjoint")
+                    seen[key] = i
+        self._devices: List = dev_list
         self.est_token_s = (float(est_token_s)
                             if est_token_s is not None else None)
         self.migration_retries = int(migration_retries)
@@ -372,8 +420,14 @@ class ServingFleet:
         # newcomers from the SAME net + config the founders got
         self._net = net
         self._server_kwargs = dict(server_kwargs)
-        self._servers = [GenerationServer(net, **server_kwargs)
-                         for _ in range(self.n_replicas)]
+        self._servers = [
+            GenerationServer(net, **(dict(server_kwargs, devices=dev)
+                                     if dev is not None
+                                     else server_kwargs))
+            for dev in dev_list]
+        for i, dev in enumerate(dev_list):
+            _REPL_DEVICES.labels(replica=str(i)).set(
+                len(dev) if dev is not None else 1)
         # disagg classification bar: prompts at least this long (>= 2
         # full KV blocks by default) route through a prefill replica
         # when one is live; shorter prompts always go direct — their
@@ -573,11 +627,15 @@ class ServingFleet:
             self._servers[idx].shutdown(drain=False, timeout=timeout)
         self._wake()
 
-    def add_replica(self, role: str = ROLE_UNIFIED) -> int:
+    def add_replica(self, role: str = ROLE_UNIFIED,
+                    devices=None) -> int:
         """LIVE SCALE-OUT: construct one more replica from the fleet's
         founding ``net`` + server config and join it; returns its
         index.  ``role`` slots it into the disagg topology (default
-        unified).  The newcomer enters the dispatch candidate set —
+        unified); ``devices`` gives the newcomer its own device slice
+        (a scaled-out replica may span chips the founders did not —
+        ONE fleet mixes single- and multi-chip replicas).  The
+        newcomer enters the dispatch candidate set —
         and the prefix-affinity probe — only after its FIRST
         successful ``stats()`` (observed by the scheduler's health
         sweep): a replica still constructing must not catch traffic it
@@ -592,7 +650,10 @@ class ServingFleet:
                 raise RuntimeError("ServingFleet has been shut down")
         # constructed OUTSIDE the lock: replica construction allocates
         # the KV pool and may compile — the fleet must keep serving
-        srv = GenerationServer(self._net, **self._server_kwargs)
+        dev = None if devices is None else list(devices)
+        srv = GenerationServer(
+            self._net, **(dict(self._server_kwargs, devices=dev)
+                          if dev is not None else self._server_kwargs))
         with self._lock:
             if self._shutdown:
                 down = True
@@ -601,8 +662,11 @@ class ServingFleet:
                 idx = len(self._servers)
                 self._servers.append(srv)
                 self._roles.append(role)
+                self._devices.append(dev)
                 self.n_replicas += 1
                 self._joining.add(idx)
+                _REPL_DEVICES.labels(replica=str(idx)).set(
+                    len(dev) if dev is not None else 1)
         if down:
             srv.shutdown(drain=False)
             raise RuntimeError("ServingFleet has been shut down")

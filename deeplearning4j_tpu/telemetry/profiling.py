@@ -150,10 +150,18 @@ class DeviceProfiler:
             return self._device
 
     @contextlib.contextmanager
-    def measure(self, phase: str, every: Optional[int] = None):
+    def measure(self, phase: str, every: Optional[int] = None,
+                devices=None):
         """Time one dispatch of ``phase`` (1-in-``every`` sampling;
         defaults to the profiler-wide rate).  An armed XProf capture
-        forces sampling so the capture window is always timed."""
+        forces sampling so the capture window is always timed.
+
+        ``devices`` (ISSUE 17): an iterable of ``platform:id`` labels
+        — a MESH-SHARDED dispatch runs on every chip of the replica's
+        slice simultaneously, so the one wall-time sample folds into
+        EACH listed device's series (per-device phase attribution
+        across the slice); None keeps the single default-device
+        label."""
         phase = str(phase)
         every = self.sample_every if every is None else max(1, int(every))
         with self._lock:
@@ -167,7 +175,9 @@ class DeviceProfiler:
             yield m
         finally:
             if sampled:
-                self.observe(phase, time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                for dev in (devices if devices else (None,)):
+                    self.observe(phase, dt, device=dev)
             else:
                 self._skipped.labels(phase=phase).inc()
             if capturing:

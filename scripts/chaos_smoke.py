@@ -17,6 +17,12 @@ kind against a real (tiny, CPU-sized) training run and a real
   nothing resubmitted), and a stuck tick with a poisoned slot drops
   ONLY that slot — the two unaffected callers finish offline-identical
   and the implicated one rides a submit retry through;
+* a MESH-SHARDED tp=2 replica (ISSUE 17) survives the same tick crash
+  — the unchanged watchdog salvages every slot into the rebuilt
+  sharded pool (byte-identical, ``tp_device_loss`` flight event on
+  the wire) — and a mixed fleet whose tp=2 replica is killed
+  mid-decode migrates every request byte-identical onto the
+  single-chip survivor (``outcome="migrated"`` on the scrape);
 * a DISAGGREGATED fleet (prefill + decode roles, ISSUE 14) survives a
   SIGKILL of its prefill replica mid-handoff: the staged requests
   re-place through the existing migration machinery onto the decode
@@ -71,6 +77,12 @@ from deeplearning4j_tpu.resilience.faults import (poison_slot_kv,
 
 SERVE_CRASH_PLAN = throttled_stall_plan(4, "serve_tick_fail@5")
 SERVE_STALL_PLAN = throttled_stall_plan(15, "serve_tick_stall@16:2.2")
+# serving scenario 3 (ISSUE 17) — the SAME crash shape against a tp=2
+# MESH-SHARDED server: from the host a failed dispatch on a multi-chip
+# replica is indistinguishable from losing one chip of the tp group
+# mid-tick, so the unchanged watchdog must salvage the sharded pool
+# and the tp_device_loss flight event must land with the slice
+SERVE_TP_CRASH_PLAN = throttled_stall_plan(4, "serve_tick_fail@5")
 
 
 def _load_check_telemetry():
@@ -355,6 +367,45 @@ def main(min_history_s: float = 60.0) -> int:
         problems.append("expected exactly 2 watchdog restarts "
                         "(crash + stall)")
 
+    # -- mesh-sharded replica (ISSUE 17): the same tick crash against
+    # a tp=2 server.  The UNCHANGED watchdog salvages every slot's KV
+    # into the rebuilt sharded pool — all three callers complete
+    # byte-identical, nothing resubmitted — and the mesh-loss flight
+    # event lands carrying the slice it spanned.
+    tp_ev = registry.counter(
+        "flight_events_total",
+        labelnames=("kind",)).labels(kind="tp_device_loss")
+    ev0 = tp_ev.value
+    salv2 = counter("kv_slots_salvaged_total").value
+    wd2 = counter("serve_watchdog_restarts_total").value
+    with GenerationServer(gpt, n_slots=3, max_len=32,
+                          tick_timeout_s=0.8, tick_batch=1,
+                          submit_retries=4, retry_backoff_s=0.02,
+                          devices=jax.devices()[:2]) as tsrv:
+        if tsrv.stats()["tp"] != 2:
+            problems.append("mesh chaos server did not build tp=2")
+        tsrv.submit(p, n_new=2, timeout=300)     # warm the compiles
+        with FaultInjector(SERVE_TP_CRASH_PLAN):
+            hs_t = [tsrv.submit_async(p, n_new=24) for _ in range(3)]
+            for i, h in enumerate(hs_t):
+                try:
+                    if not np.array_equal(h.result(timeout=300),
+                                          ref24):
+                        problems.append(
+                            f"tp=2 crash salvage output {i} mismatch")
+                except Exception as e:
+                    problems.append(f"tp=2 crash-salvaged request {i} "
+                                    f"failed: {e}")
+        if not tsrv.healthy():
+            problems.append("tp=2 server not healthy after recovery")
+    if counter("kv_slots_salvaged_total").value - salv2 != 3:
+        problems.append("tp=2 crash recovery salvaged != 3 slots")
+    if counter("serve_watchdog_restarts_total").value - wd2 != 1:
+        problems.append("tp=2 crash recovery != 1 watchdog restart")
+    if tp_ev.value - ev0 < 1:
+        problems.append("tp=2 tick crash recorded no tp_device_loss "
+                        "flight event")
+
     # -- serving fleet: SIGKILL-equivalent death of one of two
     # replicas mid-decode.  The seed request warms one replica's
     # prefix cache so affinity routes all four follow-ups there
@@ -401,6 +452,59 @@ def main(min_history_s: float = 60.0) -> int:
         mig_trace = hs[0].trace_id
     if outcome_total("migrated") - mig0 < 1:
         problems.append("fleet kill produced no migrated requests")
+
+    # -- mesh fleet (ISSUE 17): ONE fleet mixing a tp=2 replica and a
+    # single-chip replica, the MULTI-CHIP one killed mid-decode —
+    # every in-flight request migrates onto the single-chip survivor
+    # and completes byte-identical (the sharded and unsharded ticks
+    # are the same function by construction, so migrating across
+    # topologies is invisible to the caller).  The affinity seed must
+    # land on replica 0 (the tp=2 one) for the kill to catch work
+    # mid-decode; the scenario retries on a fresh fleet when cold
+    # placement sends it elsewhere, or when the short decode outruns
+    # the kill and nothing was left to migrate.
+    pm = np.arange(3, 16, dtype=np.int32)
+    ref_mesh = offline.generate(pm[None], n_new=12)[0]
+    migm0 = outcome_total("migrated")
+    for attempt in range(3):
+        with ServingFleet(gpt, n_replicas=2, n_slots=2, max_len=32,
+                          block_size=4, tick_batch=1,
+                          tick_timeout_s=None,
+                          devices=[jax.devices()[:2], None]) as mflt:
+            if mflt.replica(0).stats()["tp"] != 2 \
+                    or mflt.replica(1).stats()["tp"] != 1:
+                problems.append("mesh fleet replica topology wrong")
+            h_seed = mflt.submit_async(pm, n_new=2)
+            h_seed.result(timeout=300)
+            if h_seed.replica != 0:
+                continue             # need the tp=2 replica warm
+            hs_m = [mflt.submit_async(pm, n_new=12) for _ in range(4)]
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if any(h.emitted > 0 for h in hs_m):
+                    break            # mid-decode on the tp=2 replica
+                time.sleep(0.001)
+            mflt.kill(0)             # SIGKILL the multi-chip replica
+            for i, h in enumerate(hs_m):
+                try:
+                    if not np.array_equal(h.result(timeout=300),
+                                          ref_mesh):
+                        problems.append(
+                            f"mesh fleet migrated output {i} mismatch")
+                except Exception as e:
+                    problems.append(f"mesh fleet migrated request {i} "
+                                    f"failed: {e}")
+            if mflt.stats()["healthy_replicas"] != 1:
+                problems.append("mesh fleet survivor count != 1 "
+                                "after the tp=2 replica kill")
+            if mflt.replica(1).stats()["tp"] != 1:
+                problems.append("mesh fleet survivor is not the "
+                                "single-chip replica")
+        if outcome_total("migrated") - migm0 >= 1:
+            break                    # the kill landed mid-decode
+    else:
+        problems.append("tp=2 replica kill never migrated a request "
+                        "(3 attempts)")
 
     # -- disaggregated prefill/decode (ISSUE 14): kill the PREFILL
     # replica with long-prompt requests staged on it mid-handoff —
@@ -780,9 +884,12 @@ def main(min_history_s: float = 60.0) -> int:
     # scheduler pass) --
     expected = {k: 1 for k in resilience.FAULT_KINDS}
     expected["preempt"] = 3
+    all_serve_plans = (SERVE_CRASH_PLAN + SERVE_STALL_PLAN
+                       + SERVE_TP_CRASH_PLAN)
     expected["serve_tick_stall"] = sum(
-        s.startswith("serve_tick_stall")
-        for s in SERVE_CRASH_PLAN + SERVE_STALL_PLAN)
+        s.startswith("serve_tick_stall") for s in all_serve_plans)
+    expected["serve_tick_fail"] = sum(
+        s.startswith("serve_tick_fail") for s in all_serve_plans)
     for k in resilience.FAULT_KINDS:
         delta = fault_counter.labels(kind=k).value - faults_before[k]
         if delta != expected[k]:
@@ -864,6 +971,8 @@ def main(min_history_s: float = 60.0) -> int:
         'fleet_slo_alert_firing{slo="inter-latency"}',
         'fleet_slo_error_budget_remaining{slo="inter-latency"}',
         'flight_events_total{kind="dispatch"}',
+        # ISSUE 17: the mesh-loss event the tp=2 tick crash recorded
+        'flight_events_total{kind="tp_device_loss"}',
         'flight_events_total{kind="chaos_kill"}',
         'flight_events_total{kind="scale"}',
         'flight_events_total{kind="watchdog"}',

@@ -28,6 +28,14 @@ import time
 import urllib.error
 import urllib.request
 
+# the mesh-sharded serve smoke needs >= 2 devices; force a virtual CPU
+# pair BEFORE jax initializes (no-op in-process under tests/conftest.py,
+# which already forces 8)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
@@ -197,6 +205,21 @@ SLO_SERIES = [
     'fleet_slo_alert_firing{slo="smoke-avail",host="fleet"} 1.0',
     'fleet_slo_alert_transitions_total{slo="smoke-avail",'
     'to="firing",host="fleet"} 1',
+]
+
+# Mesh-sharded serving (ISSUE 17): the smoke below decodes one prompt
+# through a tp=2 replica spanning two virtual devices — byte-compared
+# against the single-chip server — and constructs a mixed fleet, so
+# the slice gauge, the tp-degree gauge, the forced reference_tp
+# attention route and the PER-DEVICE phase attribution (one decode
+# tick folds into EVERY chip of the slice) all carry live values.
+MESH_SERIES = [
+    'fleet_replica_devices{replica="0"} 1.0',
+    'fleet_replica_devices{replica="1"} 2.0',
+    "generation_server_tp_degree 2.0",
+    'paged_route_total{path="reference_tp"}',
+    'fleet_device_phase_seconds_count{device="cpu:1",'
+    'phase="decode_tick"}',
 ]
 
 # Flight recorder (ISSUE 15): the serve smokes above feed the
@@ -767,6 +790,45 @@ def main() -> int:
                 problems.append("postmortem bundle carries no SLO "
                                 "state")
 
+    # -- mesh-sharded serving (ISSUE 17): a tp=2 replica over two
+    # virtual devices must decode byte-identical to the single-chip
+    # server, report the GLOBAL pool's block counts (the autoscaler /
+    # placement view), and attribute its decode phase to EVERY chip of
+    # the slice; a mixed fleet puts the per-replica slice gauge on the
+    # wire ----------------------------------------------------------
+    import jax
+    if jax.device_count() < 2:
+        problems.append(f"mesh smoke needs >= 2 devices, have "
+                        f"{jax.device_count()}")
+    else:
+        tp_slice = jax.devices()[:2]
+        mp = np.asarray([3, 1, 4, 1, 5, 9], np.int32)
+        with GenerationServer(gpt, n_slots=2, max_len=32) as gm0:
+            mesh_ref = gm0.submit(mp, n_new=4, timeout=300)
+            free_plain = gm0.stats()["free_blocks"]
+        with GenerationServer(gpt, n_slots=2, max_len=32,
+                              devices=tp_slice) as gm:
+            mesh_out = gm.submit(mp, n_new=4, timeout=300)
+            mst = gm.stats()
+        if not np.array_equal(mesh_out, mesh_ref):
+            problems.append("tp=2 decode diverged from the "
+                            "single-chip decode of the same prompt")
+        if mst["tp"] != 2 or mst["devices"] != [
+                f"{d.platform}:{d.id}" for d in tp_slice]:
+            problems.append(f"sharded server stats misreport the "
+                            f"slice: tp={mst['tp']} "
+                            f"devices={mst['devices']}")
+        if mst["free_blocks"] != free_plain:
+            problems.append(
+                "sharded pool free-KV view is not the GLOBAL block "
+                f"count ({mst['free_blocks']} != {free_plain}) — the "
+                "autoscaler would see a per-shard fraction")
+        # mixed fleet: single-chip replica 0 + tp=2 replica 1 — the
+        # slice gauge needs no traffic, it is set at construction
+        with ServingFleet(gpt, n_replicas=2, n_slots=2, max_len=32,
+                          devices=[None, tp_slice]):
+            pass
+
     # -- static analysis: lint series on the wire ----------------------
     emit_analysis_series(problems)
 
@@ -813,7 +875,7 @@ def main() -> int:
         "fleet_xprof_capture_files",
     ] + PAGED_KV_SERIES + TIERED_KV_SERIES + SPEC_SERIES \
       + FLEET_SERIES + RESILIENCE_SERIES + ANALYSIS_SERIES \
-      + FORECAST_SERIES + FLIGHT_SERIES
+      + FORECAST_SERIES + FLIGHT_SERIES + MESH_SERIES
     problems += missing_series(body, required)
     if lat.count - lat_before != 16:
         problems.append(

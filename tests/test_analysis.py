@@ -662,6 +662,53 @@ class TestKvTieringProbe:
                       f"state: {[f.render() for f in fs]}")
 
 
+class TestMeshSliceProbe:
+    """ISSUE 17: the fleet's per-replica device-slice table
+    (``serving/router.py`` ``_devices``/``_servers``, mutated by
+    ``add_replica`` from caller threads while the scheduler reads) and
+    the server's shard ctx are cross-thread state — same probe pair as
+    :class:`TestKvTieringProbe`: the shipped modules' lock discipline
+    is clean, and stripping ``add_replica``'s locks re-surfaces
+    violations (the rules are not blind to the module)."""
+
+    ROUTER = os.path.join(REPO, "deeplearning4j_tpu", "serving",
+                          "router.py")
+    SERVER = os.path.join(REPO, "deeplearning4j_tpu", "parallel",
+                          "generation_server.py")
+
+    def test_shipped_modules_are_conc_clean(self):
+        for path in (self.ROUTER, self.SERVER):
+            rel = os.path.relpath(path, REPO)
+            fs = concurrency_lint.lint_source(open(path).read(), rel)
+            assert fs == [], (rel, [f.render() for f in fs])
+
+    def test_rules_see_slice_state_when_unguarded(self):
+        # strip both lock regions from add_replica only: the now-bare
+        # reads of the lock-guarded shutdown flag (gating the newcomer
+        # join) must surface as CONC202 IN add_replica — the rules see
+        # the scale-out path rather than skipping the module
+        head, _, tail = open(self.ROUTER).read().partition(
+            "def add_replica")
+        src = head + "def add_replica" + tail.replace(
+            "with self._lock:", "if True:", 2)
+        fs = concurrency_lint.lint_source(
+            src, "deeplearning4j_tpu/serving/router.py")
+        hits = [f for f in fs if f.rule in ("CONC201", "CONC202")
+                and f.symbol == "ServingFleet.add_replica"]
+        assert hits, ("CONC rules are blind to the fleet's scale-out "
+                      f"path: {[f.render() for f in fs]}")
+        # KNOWN BLIND SPOT, pinned on purpose: the slice table itself
+        # mutates via container data flow (``self._devices.append``),
+        # which the store-based guarded inference cannot classify — a
+        # bare .append is a LOAD of _devices plus a method call, never
+        # an attribute/subscript store, so _devices never enters the
+        # guarded set and the stripped-lock mutant fires on the
+        # neighboring _shutdown reads instead.  If a future rule
+        # upgrade learns mutating-call data flow, this assertion flips
+        # and the probe above should pin _devices directly.
+        assert not any("_devices" in f.message for f in fs)
+
+
 # ---------------------------------------------------------------------------
 # whole-package: index, cross-module rules, cache
 # ---------------------------------------------------------------------------
