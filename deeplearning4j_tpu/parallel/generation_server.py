@@ -630,6 +630,10 @@ class GenerationServer:
         # to trade capacity for concurrency headroom per chip)
         self._spec = (_speculative.SpecConfig.build(gen, speculative)
                       if speculative is not None else None)
+        # degradation-ladder switch (ISSUE 18): True suspends
+        # speculative rounds without tearing the draft state down —
+        # rung 3 is reversible by flipping it back
+        self._spec_off = False
         if self._spec is not None:
             demb = self._spec.draft.gen.emb
             if demb.add_positional and self.max_len > demb.max_len:
@@ -1131,6 +1135,44 @@ class GenerationServer:
         fresh server to reopen admission."""
         with self._lock:
             self._admission_closed = True
+
+    def set_spec_enabled(self, enabled: bool) -> None:
+        """Suspend (False) or resume (True) speculative decoding on a
+        live server — rung 3 of the fleet's degradation ladder
+        (ISSUE 18).  Suspension skips draft+verify rounds entirely
+        from the next tick on; the draft state stays resident, so
+        resuming costs nothing but the stale-draft-KV acceptance dip
+        the greedy fallback already tolerates.  A no-op on a server
+        built without ``speculative=``."""
+        with self._lock:
+            self._spec_off = not bool(enabled)
+
+    def demote_waiting(self, n_new_factor: Optional[float] = None,
+                       force_greedy: bool = False) -> int:
+        """Cheapen the NOT-YET-ADMITTED queue in place (ISSUE 18, the
+        degradation ladder's replica-side actuator): scale each
+        waiting request's ``n_new`` by ``n_new_factor`` (floor 1,
+        never grown) and/or flip it to greedy decode.  Active slots
+        are untouched — their budgets are already spent device-side
+        and a mid-decode sampling flip would break per-seed
+        reproducibility.  Returns how many requests changed."""
+        factor = None if n_new_factor is None else float(n_new_factor)
+        if factor is not None and not 0.0 < factor <= 1.0:
+            raise ValueError("n_new_factor must be in (0, 1]")
+        changed = 0
+        with self._lock:
+            for r in self._pending:
+                hit = False
+                if factor is not None:
+                    capped = max(1, int(r.n_new * factor))
+                    if capped < r.n_new:
+                        r.n_new = capped
+                        hit = True
+                if force_greedy and r.temperature > 0.0:
+                    r.temperature = 0.0
+                    hit = True
+                changed += hit
+        return changed
 
     def _resolve_sampling(self, sampling, seed):
         """Merge a per-request ``sampling`` dict over the server-wide
@@ -2595,6 +2637,7 @@ class GenerationServer:
                     live = list(self._active.values())
                     k_drain = max(r.n_new - r.emitted for r in live)
                     sampled = any(r.temperature > 0.0 for r in live)
+                    spec_off = self._spec_off
                 queue_busy = n_pending > 0 or not self._queue.empty()
                 # speculative rounds serve ALL-GREEDY pools (the
                 # greedy acceptance rule has no rejection-sampling
@@ -2604,7 +2647,12 @@ class GenerationServer:
                 # stale, which costs later acceptance, and the
                 # verification recomputes every committed token with
                 # the target anyway)
-                use_spec = self._spec is not None and not sampled
+                # ... and rung 3 of the degradation ladder suspends
+                # speculation outright (no draft compute at all) —
+                # the flag flips back when the rung clears, and the
+                # only cost in between is stale draft KV
+                use_spec = (self._spec is not None and not sampled
+                            and not spec_off)
                 if use_spec:
                     # adaptive round count, the scan-length rule's
                     # analogue: a single round while admission is

@@ -39,12 +39,20 @@ def retry_call(fn: Callable, retries: int = 3, base_delay: float = 0.05,
                max_delay: float = 2.0,
                retry_on: Tuple[Type[BaseException], ...] =
                (RetryableServerError,),
-               op: str = "call", seed: Optional[int] = None):
+               op: str = "call", seed: Optional[int] = None,
+               delay_floor: Optional[Callable[[BaseException], float]]
+               = None):
     """Call ``fn()``; on an exception in ``retry_on`` sleep a jittered
     exponential backoff and retry, up to ``retries`` retries (so at
     most ``retries + 1`` attempts).  Any other exception, and the last
     ``retry_on`` failure, propagate.  ``seed`` pins the jitter for
-    reproducible tests."""
+    reproducible tests.
+
+    ``delay_floor`` maps the caught exception to a MINIMUM for the
+    next sleep — the server-advised retry-after contract (ISSUE 18:
+    ``AdmissionRejectedError.retry_after_s``): jitter still spreads
+    callers out above the floor, but nobody re-knocks before the
+    server said capacity could be back."""
     rng = random.Random(seed) if seed is not None else None
     attempt = 0
     while True:
@@ -52,11 +60,17 @@ def retry_call(fn: Callable, retries: int = 3, base_delay: float = 0.05,
             result = fn()
             _ATTEMPTS.labels(op=op).observe(attempt + 1)
             return result
-        except retry_on:
+        except retry_on as e:
             if attempt >= retries:
                 _ATTEMPTS.labels(op=op).observe(attempt + 1)
                 raise
             delay = backoff_delay(attempt, base_delay, max_delay, rng)
+            if delay_floor is not None:
+                try:
+                    delay = max(delay, float(delay_floor(e) or 0.0))
+                except Exception:
+                    pass             # an advisory floor never breaks
+                                     # the retry loop itself
             _BACKOFF.labels(op=op).observe(delay)
             time.sleep(delay)
             attempt += 1

@@ -34,7 +34,15 @@ TPU-fleet retrospective says must be designed in:
   before interactive ones — and PREDICTIVELY (ISSUE 13): a
   :class:`BacklogForecaster` linear fit over the backlog series
   pre-warms a replica when the projected queue depth crosses the SLO
-  horizon, before any reactive signal trips.
+  horizon, before any reactive signal trips;
+* **overload protection** (ISSUE 18): admission-time SLO burn
+  projection (admit / degrade / reject with a server-advised
+  retry-after — :class:`~.errors.AdmissionRejectedError`), a
+  reversible graceful-degradation ladder
+  (:class:`~.degrade.DegradeLadder`: shrink budgets → force greedy →
+  spec off → shed batch), and tail-latency hedging — near-deadline
+  interactive requests race a duplicate on a second warm replica,
+  first completion wins, the loser is cancelled.
 
 Telemetry rides the PR-1 registry: ``fleet_requests_total{tenant=,
 outcome=}``, ``fleet_replica_dispatch_total{replica=,reason=}``,
@@ -48,7 +56,9 @@ from deeplearning4j_tpu.serving.autoscale import (AutoscalePolicy,
                                                   BacklogForecaster,
                                                   fit_trend,
                                                   predict_breach_s)
-from deeplearning4j_tpu.serving.errors import (DeadlineInfeasibleError,
+from deeplearning4j_tpu.serving.degrade import RUNGS, DegradeLadder
+from deeplearning4j_tpu.serving.errors import (AdmissionRejectedError,
+                                               DeadlineInfeasibleError,
                                                FleetAdmissionError,
                                                NoHealthyReplicaError,
                                                QuotaExceededError)
@@ -67,8 +77,10 @@ __all__ = [
     "ServingFleet", "TenantQuota", "TenantAccountant",
     "Autoscaler", "AutoscalePolicy", "BacklogForecaster",
     "fit_trend", "predict_breach_s",
+    "DegradeLadder", "RUNGS",
     "FleetAdmissionError", "QuotaExceededError",
     "DeadlineInfeasibleError", "NoHealthyReplicaError",
+    "AdmissionRejectedError",
     "choose_replica", "replica_view",
     "AFFINITY", "LEAST_LOADED", "FAILOVER", "PREFILL", "HANDOFF",
     "ROLES", "ROLE_PREFILL", "ROLE_DECODE", "ROLE_UNIFIED",

@@ -302,7 +302,7 @@ class Autoscaler:
                  source=None, interval_s: float = 0.5,
                  tenant_classes: Optional[Dict[str, str]] = None,
                  remove_timeout_s: float = 30.0,
-                 alert_engine=None):
+                 alert_engine=None, degrade_ladder=None):
         self.fleet = fleet
         self.policy = policy or AutoscalePolicy()
         self.source = source
@@ -315,6 +315,12 @@ class Autoscaler:
         # read from the fleet_slo_alert_firing gauge, so alerts
         # beaconed from OTHER hosts steer this loop too.
         self.alert_engine = alert_engine
+        # degradation ladder (ISSUE 18): attached, the autoscaler's
+        # loop also clocks the ladder each pass — one control thread
+        # owns both reactions to SLO burn (add capacity AND shed
+        # quality), so they observe the same projection and cannot
+        # fight on stale reads of each other's signal.
+        self.degrade_ladder = degrade_ladder
         self.interval_s = float(interval_s)
         if self.interval_s <= 0:
             raise ValueError("interval_s must be > 0")
@@ -531,6 +537,15 @@ class Autoscaler:
         else:
             alert_firing = bool(
                 self._gauge_sum(reg, "fleet_slo_alert_firing") or 0.0)
+        if self.degrade_ladder is not None:
+            # clocked here, not in its own thread: degradation steps
+            # happen on the same pass (same projection snapshot) as
+            # the scale decision they complement
+            try:
+                self.degrade_ladder.evaluate(now=now)
+            except Exception:
+                log.exception("autoscaler: degrade-ladder evaluation "
+                              "failed")
         alert_only = False
         if alert_firing:
             alert_only = not up_reasons

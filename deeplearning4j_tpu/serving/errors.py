@@ -39,3 +39,24 @@ class NoHealthyReplicaError(RetryableServerError):
     """Every replica is dead, draining, or unhealthy.  Retryable: a
     fleet in this state is being repaired (watchdog restarts, rolling
     replace), and the request was never applied anywhere."""
+
+
+class AdmissionRejectedError(FleetAdmissionError):
+    """The SLO projection says admitting this tenant's request would
+    deepen an error-budget overdraft that is already burning.  Unlike
+    ``QuotaExceededError`` this IS worth retrying — but not blindly:
+    ``retry_after_s`` is the budget-recovery slope's estimate of when
+    capacity returns, and ``submit(retries=)`` honors it as the FLOOR
+    of its next backoff instead of hammering the recovering fleet."""
+
+    def __init__(self, tenant: str, retry_after_s: float,
+                 projected_burn: float, reason: str = ""):
+        self.tenant = str(tenant)
+        self.retry_after_s = float(retry_after_s)
+        self.projected_burn = float(projected_burn)
+        msg = (f"tenant {self.tenant!r} rejected at admission: "
+               f"projected burn {self.projected_burn:.3g}x, retry "
+               f"after {self.retry_after_s:.3g}s")
+        if reason:
+            msg += f" ({reason})"
+        super().__init__(msg)

@@ -116,7 +116,8 @@ from deeplearning4j_tpu.resilience.errors import (CancelledError,
                                                   DeadlineExceededError,
                                                   RetryableServerError)
 from deeplearning4j_tpu.resilience.retry import backoff_delay, retry_call
-from deeplearning4j_tpu.serving.errors import (DeadlineInfeasibleError,
+from deeplearning4j_tpu.serving.errors import (AdmissionRejectedError,
+                                               DeadlineInfeasibleError,
                                                NoHealthyReplicaError,
                                                QuotaExceededError)
 from deeplearning4j_tpu.serving.placement import (FAILOVER, HANDOFF,
@@ -134,7 +135,8 @@ _REQS = telemetry.counter(
     "fleet admission outcomes per tenant: admitted (first dispatch "
     "to a replica — a disagg request's prefill placement), queued "
     "(waited >= 1 pass on quota/capacity), rejected_quota, "
-    "rejected_deadline (infeasible SLO), migrated (re-placed off a "
+    "rejected_deadline (infeasible SLO), rejected_slo (admission-"
+    "time burn projection / rung-4 shed), migrated (re-placed off a "
     "dead/drained replica), handed_off (a disagg request's decode "
     "placement carrying its exported prefix), cancelled, expired, "
     "failed", labelnames=("tenant", "outcome"))
@@ -184,6 +186,39 @@ _SLO_DEFER = telemetry.counter(
     "their tenant's SLO error budget is exhausted (ISSUE 15: "
     "budget-exhausted batch work defers BEFORE any interactive "
     "tenant is shed)", labelnames=("tenant",))
+# Production front door (ISSUE 18): admission-time SLO projection and
+# degradation-ladder shaping, counted per tenant BEFORE any reserve —
+# a rejected request costs the pool nothing, and the three outcomes
+# partition every submit_async that reached the front door.
+_ADMIT_OK = telemetry.counter(
+    "fleet_admission_admitted_total",
+    "requests admitted untouched by the SLO projection and the "
+    "degradation ladder", labelnames=("tenant",))
+_ADMIT_DEG = telemetry.counter(
+    "fleet_admission_degraded_total",
+    "requests admitted DEGRADED (n_new capped and/or forced greedy) "
+    "by the SLO projection or the active degradation rung",
+    labelnames=("tenant",))
+_ADMIT_REJ = telemetry.counter(
+    "fleet_admission_rejected_total",
+    "requests rejected at admission with AdmissionRejectedError "
+    "(projected budget overdraft, or rung 4 shedding the batch "
+    "class) — zero replica cost, retry_after_s attached",
+    labelnames=("tenant",))
+# Tail-latency hedging (ISSUE 18): near-deadline interactive requests
+# duplicate onto a second warm replica; first completion wins.
+_HEDGE_LAUNCH = telemetry.counter(
+    "fleet_hedges_launched_total",
+    "hedge placements launched (a near-deadline request duplicated "
+    "byte-identically onto a second warm replica, raced first-wins)")
+_HEDGE_WON = telemetry.counter(
+    "fleet_hedges_won_total",
+    "hedge races the HEDGE placement won (the primary was cancelled "
+    "and the hedge's bytes delivered)")
+_HEDGE_CANCEL = telemetry.counter(
+    "fleet_hedges_cancelled_total",
+    "hedge races resolved by cancelling the loser — exactly one per "
+    "resolved race, whichever side lost")
 
 #: the per-host flight recorder (ISSUE 15): placement decisions,
 #: migrations, handoffs and chaos kills land in the black-box ring a
@@ -213,7 +248,8 @@ class _FleetRequest:
                  "tenant", "priority", "cost", "deadline", "t_submit",
                  "t_submit_m", "cancelled", "migrations", "replica",
                  "inner", "ttft", "trace_id", "spans", "stage",
-                 "handoff", "prefill_replica", "_t_dispatch",
+                 "handoff", "prefill_replica", "hedge_inner",
+                 "hedge_replica", "_t_hedge", "_t_dispatch",
                  "_not_before", "_migrate", "_quota_held",
                  "_queued_counted", "_migrating", "_budget_deferred",
                  "_result", "_error", "_event")
@@ -246,6 +282,14 @@ class _FleetRequest:
         self.stage: Optional[str] = None
         self.handoff = None
         self.prefill_replica: Optional[int] = None
+        # tail-latency hedge (ISSUE 18): a SECOND byte-identical
+        # placement racing the primary; first completion wins and the
+        # loser is cancelled through its replica-side handle
+        self.hedge_inner = None
+        self.hedge_replica: Optional[int] = None
+        self._t_hedge = None          # hedge launch wall time (the
+                                      # winner's ttft base when the
+                                      # hedge wins)
         self.ttft = None              # submit -> first token of the
                                       # SUCCESSFUL attempt (queue wait
                                       # + any migration included)
@@ -296,6 +340,9 @@ class _FleetRequest:
         inner = self.inner
         if inner is not None:
             inner.cancel()
+        hedge = self.hedge_inner
+        if hedge is not None:
+            hedge.cancel()
         return True
 
 
@@ -338,7 +385,21 @@ class ServingFleet:
     single-chip and multi-chip replicas.  Slices must be disjoint.
     The router itself stays placement-policy-only: affinity /
     least-loaded / failover ranking never looks at what a replica
-    spans.  Remaining
+    spans.
+
+    The production front door (ISSUE 18): ``slo_engine`` +
+    ``admission_control=True`` projects the tenant's SLO burn at
+    ``submit`` — reject (typed
+    :class:`~.errors.AdmissionRejectedError` with a server-advised
+    ``retry_after_s``; ``submit(retries=)`` floors its backoff there)
+    or degrade BEFORE any quota token or KV block is spent; an
+    attached :class:`~.degrade.DegradeLadder`
+    (:meth:`attach_degrade`) shapes admissions whenever its rung is
+    elevated, flag or no flag.  ``hedge_slack_s`` arms tail-latency
+    hedging: a decoding request whose deadline slack dips under it
+    duplicates onto a second warm replica, first completion wins and
+    the loser is cancelled, with ``hedge_budget`` bounding hedges to
+    a fraction of admissions.  Remaining
     ``**server_kwargs`` construct the replicas (``speculative`` —
     draft-verified multi-token decode, whose per-replica acceptance
     rate surfaces through ``stats()`` — plus ``n_slots``,
@@ -357,6 +418,9 @@ class ServingFleet:
                  devices: Optional[Iterable] = None,
                  prefill_threshold: Optional[int] = None,
                  slo_engine=None,
+                 admission_control: bool = False,
+                 hedge_slack_s: Optional[float] = None,
+                 hedge_budget: float = 0.25,
                  **server_kwargs):
         self.n_replicas = int(n_replicas)
         if self.n_replicas < 1:
@@ -442,6 +506,29 @@ class ServingFleet:
         # its priority class — budget-exhausted batch traffic defers
         # before any interactive tenant would be shed
         self._slo = slo_engine
+        # production front door (ISSUE 18).  admission_control=True
+        # makes every submit consult the engine's SLO projection
+        # BEFORE any reserve (admit / degrade / reject with retry-
+        # after) — opt-in, because an attached engine alone must not
+        # start reshaping fleets that only wanted dispatch-order
+        # deferral.  The degradation ladder attaches via
+        # attach_degrade and shapes admission whenever its rung > 0.
+        self.admission_control = bool(admission_control)
+        self._degrade = None
+        # tail-latency hedging: a deadline-carrying interactive
+        # request whose remaining budget falls under hedge_slack_s
+        # duplicates onto a second warm replica (byte-identical
+        # re-place, raced first-wins).  None disables.  hedge_budget
+        # bounds concurrent hedges to a fraction of the flight — the
+        # defense must not amplify the overload it defends against.
+        self.hedge_slack_s = (None if hedge_slack_s is None
+                              else float(hedge_slack_s))
+        if self.hedge_slack_s is not None and self.hedge_slack_s <= 0:
+            raise ValueError("hedge_slack_s must be > 0 (or None to "
+                             "disable hedging)")
+        self.hedge_budget = float(hedge_budget)
+        if not 0.0 <= self.hedge_budget <= 1.0:
+            raise ValueError("hedge_budget must be in [0, 1]")
         # fleet scheduler state: everything below mutates ONLY under
         # _lock (the GenerationServer discipline, one level up)
         self._lock = threading.RLock()
@@ -498,6 +585,46 @@ class ServingFleet:
                 f"prompt ({len(prompt)}) + n_new ({n_new}) exceeds the "
                 f"replica cache length ({max_len})")
         tenant = str(tenant)
+        # production front door (ISSUE 18): the SLO projection and
+        # the degradation ladder run BEFORE the reserve, the cost
+        # computation and the feasibility screen — a reject burns no
+        # quota, no blocks, no prefill, and the shaped (capped /
+        # greedy) request is what everything downstream costs.
+        with self._lock:
+            slo = self._slo if self.admission_control else None
+            ladder = self._degrade
+        degraded = False
+        if slo is not None and hasattr(slo, "admission_decision"):
+            verdict = slo.admission_decision(tenant)
+            if verdict["decision"] == "reject":
+                _ADMIT_REJ.labels(tenant=tenant).inc()
+                _REQS.labels(tenant=tenant,
+                             outcome="rejected_slo").inc()
+                raise AdmissionRejectedError(
+                    tenant, verdict["retry_after_s"],
+                    verdict["projected_burn"],
+                    reason=f"SLO {verdict['slo']} projects the "
+                           "budget overdraft deepening")
+            if verdict["decision"] == "degrade":
+                capped = max(1, n_new // 2)
+                degraded = degraded or capped < n_new
+                n_new = capped
+        if ladder is not None:
+            n_new, sampling, shape = ladder.shape_admission(
+                tenant, n_new, sampling)
+            if shape == "reject":
+                _ADMIT_REJ.labels(tenant=tenant).inc()
+                _REQS.labels(tenant=tenant,
+                             outcome="rejected_slo").inc()
+                raise AdmissionRejectedError(
+                    tenant, ladder.shed_retry_after_s,
+                    ladder.state()["burn"],
+                    reason=f"degradation rung {ladder.rung()} sheds "
+                           "the batch class")
+            degraded = degraded or shape == "degraded"
+        if ladder is not None or slo is not None:
+            (_ADMIT_DEG if degraded else _ADMIT_OK).labels(
+                tenant=tenant).inc()
         cost = float(len(prompt) + n_new)
         if deadline_s is not None:
             deadline_s = float(deadline_s)
@@ -569,8 +696,12 @@ class ServingFleet:
                retries: int = 0) -> np.ndarray:
         """Blocking ``submit_async().result()``.  ``retries``
         re-submits after a ``RetryableServerError`` (e.g. the whole
-        fleet was momentarily unhealthy) through the existing
-        ``retry_call`` machinery with full-jitter backoff."""
+        fleet was momentarily unhealthy) or an
+        ``AdmissionRejectedError`` through the existing ``retry_call``
+        machinery with full-jitter backoff — an admission rejection's
+        ``retry_after_s`` is honored as the FLOOR of the next sleep
+        (the server-advised recovery slope outranks blind
+        exponential; jitter still spreads callers above it)."""
 
         def attempt():
             return self.submit_async(
@@ -580,9 +711,12 @@ class ServingFleet:
 
         if retries <= 0:
             return attempt()
-        return retry_call(attempt, retries=int(retries),
-                          base_delay=self.retry_backoff_s,
-                          op="serving_fleet.submit")
+        return retry_call(
+            attempt, retries=int(retries),
+            base_delay=self.retry_backoff_s,
+            retry_on=(RetryableServerError, AdmissionRejectedError),
+            delay_floor=lambda e: getattr(e, "retry_after_s", 0.0),
+            op="serving_fleet.submit")
 
     def drain(self, replica: int, hard: bool = False) -> None:
         """Roll ``replica`` out of dispatch: admission to it stops
@@ -727,6 +861,65 @@ class ServingFleet:
         with self._lock:
             self._slo = engine
 
+    def attach_degrade(self, ladder) -> None:
+        """Attach (or replace; None detaches) the degradation ladder
+        (ISSUE 18): every admission is shaped through its current
+        rung, and rung changes actuate through
+        :meth:`apply_degrade`."""
+        with self._lock:
+            self._degrade = ladder
+
+    def apply_degrade(self, max_n_new_factor: Optional[float] = None,
+                      min_n_new: int = 1, force_greedy: bool = False,
+                      spec: bool = True,
+                      shed_tenants: Iterable[str] = ()) -> None:
+        """Actuate one degradation-ladder policy on the LIVE fleet
+        (new admissions are shaped separately, per request): cap the
+        wait lines' ``n_new`` budgets, flip waiting work to greedy,
+        suspend/resume speculative decoding per replica, and shed the
+        named tenants' waiting requests.  Idempotent — the ladder
+        calls it once per rung change with the FULL nested policy, so
+        re-applying a rung is harmless."""
+        shed = tuple(str(t) for t in shed_tenants)
+        demoted = 0
+        with self._lock:
+            # wait-line demotion under the fleet lock: the dispatch
+            # pass reads n_new/sampling/cost under the same lock, so
+            # a request is either shaped HERE or dispatched with its
+            # old budget — never half of each
+            for req in self._waiting:
+                if max_n_new_factor is not None:
+                    capped = max(max(1, int(min_n_new)),
+                                 int(req.n_new
+                                     * float(max_n_new_factor)))
+                    if capped < req.n_new:
+                        req.n_new = capped
+                        req.cost = float(len(req.prompt) + req.n_new)
+                        demoted += 1
+                if force_greedy:
+                    temp = (req.sampling or {}).get("temperature",
+                                                    None)
+                    if temp is None or float(temp) > 0.0:
+                        req.sampling = {"temperature": 0.0}
+                        demoted += 1
+            servers = list(self._servers)
+            dead = set(self._dead) | set(self._removed)
+        for i, srv in enumerate(servers):
+            if i in dead:
+                continue
+            try:
+                srv.set_spec_enabled(spec)
+                demoted += srv.demote_waiting(
+                    n_new_factor=max_n_new_factor,
+                    force_greedy=force_greedy)
+            except Exception:
+                log.exception("degrade actuation on replica %d "
+                              "failed", i)
+        if shed:
+            demoted += self.demote_waiting(shed, cancel=True)
+        if demoted:
+            self._wake()
+
     def demote_waiting(self, tenants: Iterable[str],
                        priority: Optional[int] = None,
                        cancel: bool = False) -> int:
@@ -862,10 +1055,16 @@ class ServingFleet:
             victims = [r for r in self._inflight if r.replica == idx]
             for req in victims:
                 req._migrate = True
+            hedged = [r for r in self._inflight
+                      if r.hedge_replica == idx]
         for req in victims:
             inner = req.inner
             if inner is not None:
                 inner.cancel()
+        for req in hedged:
+            # the HEDGE placement died with the replica: resolve its
+            # race — the primary races on alone
+            self._drop_hedge(req)
 
     def _fail_leftovers(self) -> None:
         """Drain and fail intake entries once the scheduler is gone."""
@@ -891,6 +1090,7 @@ class ServingFleet:
             self._waiting = []
             self._inflight = []
         for req in victims:
+            self._drop_hedge(req, "failed")
             inner = req.inner
             if inner is not None:
                 inner.cancel()
@@ -1322,16 +1522,159 @@ class ServingFleet:
         sp_place.end(outcome="refused")
         return "refused", None       # every candidate refused
 
+    def _drop_hedge(self, req: _FleetRequest,
+                    outcome: str = "cancelled") -> None:
+        """Resolve a hedge race AGAINST the hedge (the primary won,
+        or the request went terminal/migrating): detach the hedge
+        handle, cancel it, flush its replica-side spans, and count
+        the resolution — exactly one ``fleet_hedges_cancelled_total``
+        per resolved race, whichever side lost."""
+        with self._lock:
+            hedge = req.hedge_inner
+            req.hedge_inner = None
+            req.hedge_replica = None
+        if hedge is None:
+            return
+        hedge.cancel()
+        hedge.close_spans(outcome)
+        _HEDGE_CANCEL.inc()
+
+    def _hedge_pass(self, now: float) -> int:
+        """Tail-latency hedging (ISSUE 18): duplicate each
+        near-deadline interactive decode onto a second warm replica —
+        the SAME prompt/n_new/seed/sampling, so greedy decode makes
+        the two placements byte-identical and first-completion-wins
+        is a pure latency race.  Bounded by ``hedge_budget`` (a
+        fraction of the current flight) so hedging cannot amplify the
+        overload it defends against.  Returns hedges launched."""
+        if self.hedge_slack_s is None:
+            return 0
+        with self._lock:
+            flight = list(self._inflight)
+            n_hedged = sum(1 for r in flight
+                           if r.hedge_inner is not None)
+            roles = list(self._roles)
+            cand = [i for i in range(len(self._servers))
+                    if i not in self._dead
+                    and i not in self._draining
+                    and i not in self._removed
+                    and i not in self._joining
+                    and roles[i] != ROLE_PREFILL]
+        budget = max(1, int(self.hedge_budget * len(flight)))
+        launched = 0
+        stats_cache: Dict[int, dict] = {}
+        for req in flight:
+            if n_hedged + launched >= budget:
+                break
+            if (req.hedge_inner is not None or req.deadline is None
+                    or req.priority > 0 or req.cancelled
+                    or req._migrate or req.stage == "prefill"
+                    or req.inner is None or req.inner.done()):
+                continue
+            remaining = req.deadline - now
+            if remaining <= 0 or remaining >= self.hedge_slack_s:
+                continue
+            targets = []
+            for i in cand:
+                if i == req.replica:
+                    continue
+                st = stats_cache.get(i)
+                if st is None:
+                    try:
+                        st = self._servers[i].stats()
+                    except Exception:
+                        continue
+                    stats_cache[i] = st
+                if st["healthy"] and not st["draining"]:
+                    targets.append((-(st["free_blocks"]
+                                      - st["queue_depth"]), i))
+            if not targets:
+                continue
+            tgt = min(targets)[1]       # most free blocks, least queue
+            srv = self._servers[tgt]
+            rem = max(req.deadline - time.monotonic(), 1e-3)
+            try:
+                hedge = srv.submit_async(
+                    req.prompt, req.n_new, eos_id=req.eos_id,
+                    seed=req.seed, deadline_s=rem,
+                    sampling=req.sampling, trace_id=req.trace_id)
+            except Exception:
+                continue             # raced drain/shutdown: no hedge
+            committed = False
+            with self._lock:
+                if (req in self._inflight and req.hedge_inner is None
+                        and not req._migrate and not req.cancelled):
+                    req.hedge_inner = hedge
+                    req.hedge_replica = tgt
+                    req._t_hedge = time.perf_counter()
+                    committed = True
+            if not committed:
+                # the primary resolved (or went terminal) between the
+                # snapshot and the launch: the race is void
+                hedge.cancel()
+                hedge.close_spans("cancelled")
+                continue
+            _HEDGE_LAUNCH.inc()
+            _FLIGHT.record("hedge", trace=req.trace_id,
+                           tenant=req.tenant, primary=req.replica,
+                           replica=tgt,
+                           remaining_s=round(remaining, 4))
+            launched += 1
+        return launched
+
     def _completion_pass(self, now: float) -> int:
         """Resolve finished replica-side handles: deliver results,
         propagate terminal errors, and REQUEUE migration candidates
         (dead/hard-drained replica, or a retryable server failure)
-        with jittered backoff.  Returns the number resolved."""
+        with jittered backoff.  A hedged request resolves FIRST-WINS:
+        whichever placement finishes first delivers its bytes and the
+        loser is cancelled.  Returns the number resolved."""
         with self._lock:
             flight = list(self._inflight)
         n_done = 0
         for req in flight:
             inner = req.inner
+            hedge = req.hedge_inner
+            if (hedge is not None and hedge.done()
+                    and not (inner is not None and inner.done())):
+                herr = None
+                try:
+                    hres = hedge.result(timeout=1.0)
+                except BaseException as e:
+                    herr, hres = e, None
+                if herr is None:
+                    # the hedge WON: adopt its placement (ttft re-
+                    # based on the hedge launch — the caller's wait
+                    # really did end with the hedge's first token),
+                    # cancel the primary, deliver
+                    with self._lock:
+                        if req in self._inflight:
+                            self._inflight.remove(req)
+                        req.inner = hedge
+                        req.replica = req.hedge_replica
+                        req.hedge_inner = None
+                        req.hedge_replica = None
+                        if req._t_hedge is not None:
+                            req._t_dispatch = req._t_hedge
+                    if inner is not None:
+                        inner.cancel()
+                        inner.close_spans("cancelled")
+                    _HEDGE_WON.inc()
+                    _HEDGE_CANCEL.inc()
+                    _FLIGHT.record("hedge_won", trace=req.trace_id,
+                                   replica=req.replica)
+                    self._finish(req, result=hres)
+                    n_done += 1
+                    continue
+                # the hedge died (its replica drained/expired it):
+                # the primary races on alone — resolve the race
+                # against the hedge
+                with self._lock:
+                    req.hedge_inner = None
+                    req.hedge_replica = None
+                hedge.close_spans("failed")
+                _HEDGE_CANCEL.inc()
+                hedge = None
             if inner is None or not inner.done():
                 if req._migrate:
                     # the placement is GONE (dead replica or hard
@@ -1356,6 +1699,8 @@ class ServingFleet:
                     # cancelled/expired cases fall to the next reap)
                     self._hand_off(req)
                     continue
+                # the primary won any hedge race: cancel the hedge
+                self._drop_hedge(req)
                 with self._lock:
                     if req in self._inflight:
                         self._inflight.remove(req)
@@ -1367,7 +1712,26 @@ class ServingFleet:
             elif isinstance(err, DeadlineExceededError):
                 self._remove_and_finish(req, err, "expired")
             elif self._migratable(req, err, now):
-                self._requeue(req, now)
+                if hedge is not None:
+                    # the hedge IS the migration: promote the live
+                    # second placement instead of re-placing from
+                    # scratch (no backoff, no lost progress) — the
+                    # race resolves against the dead primary
+                    with self._lock:
+                        req.inner = hedge
+                        req.replica = req.hedge_replica
+                        req.hedge_inner = None
+                        req.hedge_replica = None
+                        req._migrate = False
+                        if req._t_hedge is not None:
+                            req._t_dispatch = req._t_hedge
+                    inner.close_spans("abandoned")
+                    _HEDGE_CANCEL.inc()
+                    _FLIGHT.record("hedge_promote",
+                                   trace=req.trace_id,
+                                   replica=req.replica)
+                else:
+                    self._requeue(req, now)
             else:
                 self._remove_and_finish(req, err, "failed")
         return n_done
@@ -1432,6 +1796,7 @@ class ServingFleet:
 
     def _remove_and_finish(self, req: _FleetRequest, err,
                            outcome: str) -> None:
+        self._drop_hedge(req, outcome)
         inner = req.inner
         if inner is not None:
             # terminal abandon paths included: a dying replica's
@@ -1470,6 +1835,7 @@ class ServingFleet:
         return isinstance(err, RetryableServerError)
 
     def _requeue(self, req: _FleetRequest, now: float) -> None:
+        self._drop_hedge(req, "abandoned")
         req.migrations += 1
         _FLIGHT.record("migrate", trace=req.trace_id,
                        tenant=req.tenant, off_replica=req.replica,
@@ -1528,12 +1894,14 @@ class ServingFleet:
                 self._sweep_health(now)
                 self._reap_waiting(now)
                 n_disp = self._dispatch_pass(now)
+                n_hedge = self._hedge_pass(now)
                 n_done = self._completion_pass(now)
                 with self._lock:
                     busy = bool(self._waiting or self._inflight)
                     depth = len(self._waiting)
                 _FLEET_QDEPTH.set(depth + self._intake.qsize())
-                if busy and not (n_disp or n_done) and not stop:
+                if busy and not (n_disp or n_hedge or n_done) \
+                        and not stop:
                     # nothing moved: sleep ON the intake so a new
                     # submit / wake nudge cuts the latency short
                     try:

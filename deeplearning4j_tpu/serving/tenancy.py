@@ -39,12 +39,17 @@ class TenantQuota:
     degenerate where the fleet behaves like a bare server pool)."""
 
     __slots__ = ("tokens_per_s", "burst_tokens", "max_concurrent",
-                 "max_queued")
+                 "max_queued", "klass")
+
+    #: admission classes the degradation ladder dispatches on:
+    #: ``batch`` work is sheddable at rung 4, ``interactive`` never is
+    CLASSES = ("interactive", "batch")
 
     def __init__(self, tokens_per_s: float = _INF,
                  burst_tokens: Optional[float] = None,
                  max_concurrent: Optional[int] = None,
-                 max_queued: Optional[int] = None):
+                 max_queued: Optional[int] = None,
+                 klass: str = "interactive"):
         self.tokens_per_s = float(tokens_per_s)
         if self.tokens_per_s < 0:
             raise ValueError("tokens_per_s must be >= 0")
@@ -65,12 +70,17 @@ class TenantQuota:
                            else int(max_queued))
         if self.max_queued is not None and self.max_queued < 0:
             raise ValueError("max_queued must be >= 0")
+        self.klass = str(klass)
+        if self.klass not in self.CLASSES:
+            raise ValueError(f"klass={klass!r} must be one of "
+                             f"{self.CLASSES}")
 
     def __repr__(self):
         return (f"TenantQuota(tokens_per_s={self.tokens_per_s}, "
                 f"burst_tokens={self.burst_tokens}, "
                 f"max_concurrent={self.max_concurrent}, "
-                f"max_queued={self.max_queued})")
+                f"max_queued={self.max_queued}, "
+                f"klass={self.klass!r})")
 
 
 class _Bucket:
@@ -110,6 +120,16 @@ class TenantAccountant:
     def quota_for(self, tenant: str) -> TenantQuota:
         with self._lock:
             return self._quotas.get(tenant, self._default)
+
+    def tenants_of_class(self, klass: str) -> tuple:
+        """CONFIGURED tenants whose quota carries ``klass`` — the
+        degradation ladder's default shed set (``"batch"``).  Only
+        explicitly-quota'd tenants count: the default quota's class
+        must not silently make every unknown tenant sheddable."""
+        with self._lock:
+            return tuple(sorted(
+                t for t, q in self._quotas.items()
+                if q.klass == str(klass)))
 
     def _bucket_locked(self, tenant: str, now: float) -> _Bucket:
         b = self._buckets.get(tenant)

@@ -606,6 +606,100 @@ class AlertEngine:
                 if spec.tenant is not None
                 and self._st[spec.name].remaining <= 0.0)
 
+    # -- admission projection (ISSUE 18) -------------------------------
+    def _projected_burn_locked(self, spec: SLOSpec, now: float
+                               ) -> Tuple[float, bool]:
+        """(projected burn, covered) for one spec, read from the
+        shared TSDB history.  The projection is the short-window burn
+        extrapolated by its trend against the long window — ``b_short
+        + max(0, b_short - b_long)`` — the worst across the spec's
+        burn windows.  Coverage-gated EXACTLY like the alert
+        condition (min_events over the long window AND the history
+        span covering it), so a young store projects (0, False) and
+        can never reject: the same first-blip discipline the
+        multi-window alert shape exists for."""
+        key = self._series_key(spec)
+        span = self.history.span(key)
+        projected, covered = 0.0, False
+        for short_s, long_s, _thresh, _sev in spec.windows:
+            gl, bl_bad = self._window_counts(spec, now, long_s)
+            if gl + bl_bad < spec.min_events or span < long_s - 1e-9:
+                continue
+            covered = True
+            bs = burn_rate(*self._window_counts(spec, now, short_s),
+                           spec.budget)
+            bl = burn_rate(gl, bl_bad, spec.budget)
+            projected = max(projected, bs + max(0.0, bs - bl))
+        return projected, covered
+
+    def projection(self, now: Optional[float] = None) -> List[dict]:
+        """Per-spec projected burn for admission control and the
+        degradation ladder — one entry per spec: ``{slo, tenant,
+        projected_burn, covered, budget_remaining}``.  Pure read of
+        the history already folded by :meth:`evaluate`; call that
+        first (the engine loop does)."""
+        now = time.monotonic() if now is None else float(now)
+        out = []
+        with self._lock:
+            for spec in self.specs:
+                p, cov = self._projected_burn_locked(spec, now)
+                out.append({
+                    "slo": spec.name, "tenant": spec.tenant,
+                    "projected_burn": p, "covered": cov,
+                    "budget_remaining": self._st[spec.name].remaining})
+        return out
+
+    def admission_decision(self, tenant: str,
+                           now: Optional[float] = None) -> dict:
+        """Map one tenant to ``admit`` / ``degrade`` / ``reject``
+        BEFORE the fleet spends anything on the request.
+
+        Reject is deliberately narrow: a spec NAMING this tenant must
+        project burn at or above its worst (page-severity) threshold
+        with the error budget already overdrawn — a tenant-less fleet
+        SLO can only ever degrade (shared pain shapes everyone, it
+        does not single anyone out).  ``retry_after_s`` comes from
+        the budget-recovery slope: the overdraft slides out of the
+        budget window at the rate it was burned in, so the wait is
+        ``window_s * deficit / spent`` clamped to [shortest burn
+        window, window_s]."""
+        now = time.monotonic() if now is None else float(now)
+        tenant = str(tenant)
+        verdict = {"decision": "admit", "retry_after_s": 0.0,
+                   "projected_burn": 0.0, "slo": None}
+        with self._lock:
+            for spec in self.specs:
+                if spec.tenant is not None and spec.tenant != tenant:
+                    continue
+                projected, covered = self._projected_burn_locked(
+                    spec, now)
+                if not covered or projected <= 0.0:
+                    continue
+                st = self._st[spec.name]
+                threshs = [t for _s, _l, t, _v in spec.windows]
+                pages = [t for _s, _l, t, sev in spec.windows
+                         if sev == "page"]
+                hard = max(pages) if pages else max(threshs)
+                if (spec.tenant == tenant and projected >= hard
+                        and st.remaining <= 0.0):
+                    spent = 1.0 - st.remaining
+                    deficit = -st.remaining
+                    shortest = min(s for s, _l, _t, _v in spec.windows)
+                    retry = (spec.window_s * deficit / spent
+                             if spent > 0 else shortest)
+                    retry = min(max(retry, shortest), spec.window_s)
+                    return {"decision": "reject",
+                            "retry_after_s": retry,
+                            "projected_burn": projected,
+                            "slo": spec.name}
+                if (projected >= min(threshs)
+                        and projected > verdict["projected_burn"]):
+                    verdict = {"decision": "degrade",
+                               "retry_after_s": 0.0,
+                               "projected_burn": projected,
+                               "slo": spec.name}
+        return verdict
+
     def state(self) -> dict:
         """The full engine snapshot — the ``/alerts`` document and
         the postmortem bundle's ``slo`` section."""

@@ -27,6 +27,15 @@ kind against a real (tiny, CPU-sized) training run and a real
   SIGKILL of its prefill replica mid-handoff: the staged requests
   re-place through the existing migration machinery onto the decode
   survivor and complete byte-identical to offline ``generate()``;
+* an induced OVERLOAD STORM (ISSUE 18) walks the production front
+  door end to end: the admission projection sheds the batch tenant
+  with a server-advised retry-after, the degradation ladder climbs to
+  the shed rung and walks back down once the burn clears, interactive
+  traffic rides through with zero deadline misses (degraded outputs
+  byte-identical to the capped offline prefix), a near-deadline
+  request races a hedge whose loser is cancelled, and the whole
+  ladder walk is replayed from the recorded TSDB history over
+  ``/query``;
 * every recovery event landed in the telemetry registry
   (``faults_injected_total{kind=...}`` for each kind, resume/preempt/
   bad-step/watchdog counters, ``fleet_*`` + ``kv_slots_*`` counters,
@@ -866,6 +875,150 @@ def main(min_history_s: float = 60.0) -> int:
                             "nothing for a bundle with history")
     shutil.rmtree(slo_dir, ignore_errors=True)
 
+    # -- production front door (ISSUE 18): a REAL overload storm, no
+    # FaultInjector (the fault-count matrix below stays exact).  An
+    # all-bad batch tenant aged past the long burn window drives the
+    # engine's admission projection; the attached ladder walks a
+    # 2-replica fleet to the shed rung — the batch tenant is REJECTED
+    # with a server-advised retry-after, interactive budgets are
+    # capped — holds there long enough for the 1s TSDB recorder to
+    # witness the elevated rung, then walks back to rung 0 once the
+    # burn clears.  Interactive traffic rides straight through with
+    # ZERO deadline misses, a near-deadline request races a hedge on
+    # the second replica (first completion wins, the loser is always
+    # cancelled), and the whole ladder walk is REPLAYED from the
+    # recorded history over /query (ISSUE 16). ---------------------
+    from deeplearning4j_tpu.serving import (AdmissionRejectedError,
+                                            DegradeLadder, TenantQuota)
+
+    dreg = telemetry.MetricsRegistry()
+    dfam = dreg.counter("fleet_requests_total",
+                        labelnames=("tenant", "outcome"))
+    deg_eng = AlertEngine(
+        [SLOSpec("smoke-degrade", target=0.9, tenant="bulk",
+                 window_s=600.0, windows=[(0.1, 0.3, 1.5, "page")])],
+        source=dreg, registry=telemetry.MetricsRegistry())
+    deg_eng.evaluate(now=0.0)            # prime the history
+    for t in (0.2, 0.4, 0.6):            # 100% bad, past the 0.3s
+        dfam.labels(tenant="bulk", outcome="failed").inc(5)
+        deg_eng.evaluate(now=t)          # long window: burn 10x
+    exp_d0 = outcome_total("expired")
+    hlaunch = counter("fleet_hedges_launched_total")
+    hcancel = counter("fleet_hedges_cancelled_total")
+    hl0, hc0 = hlaunch.value, hcancel.value
+    pd_ = np.asarray([2, 3, 5, 7], np.int32)
+    ref_deg = offline.generate(pd_[None], n_new=2)[0]
+    ref_full = offline.generate(pd_[None], n_new=8)[0]
+    wall_deg0 = time.time()
+    with ServingFleet(gpt, n_replicas=2, n_slots=2, max_len=32,
+                      block_size=4, tick_batch=1, tick_timeout_s=None,
+                      hedge_slack_s=60.0,
+                      quotas={"bulk": TenantQuota(klass="batch")}
+                      ) as dfleet:
+        lad = DegradeLadder(dfleet, deg_eng,
+                            thresholds=(1.0, 2.0, 3.0, 4.0),
+                            hold_down_s=0.0)
+        dfleet.attach_degrade(lad)
+        rung_hi = lad.evaluate(now=0.6)  # real projection read
+        if rung_hi < 2:
+            problems.append(f"induced 10x burn drove the ladder to "
+                            f"rung {rung_hi}, expected >= 2")
+        try:
+            dfleet.submit_async(np.asarray([1, 2, 3], np.int32), 4,
+                                tenant="bulk")
+            problems.append("batch tenant admitted during the "
+                            "overload storm (shed rung must reject)")
+        except AdmissionRejectedError as e:
+            if not e.retry_after_s > 0:
+                problems.append("shed batch tenant carried no "
+                                "retry_after_s hint")
+        # the interactive storm rides THROUGH the overload: degraded
+        # (n_new capped 8 -> 2, greedy forced) but never rejected and
+        # never expired, and the capped outputs stay byte-identical
+        # to the offline prefix
+        hds = [dfleet.submit_async(pd_, n_new=8, tenant="chat",
+                                   deadline_s=300.0)
+               for _ in range(6)]
+        # hold the rung while the 1s-cadence recorder samples it: the
+        # /query replay below reads the RECORDED walk, so at least
+        # one beacon tick must witness the elevated rung
+        time.sleep(2.2)
+        for i, h in enumerate(hds):
+            try:
+                if not np.array_equal(h.result(timeout=300), ref_deg):
+                    problems.append(
+                        f"degraded storm output {i} not "
+                        "byte-identical to the capped offline prefix")
+            except Exception as e:
+                problems.append(f"degraded storm request {i} failed "
+                                f"during the overload: {e}")
+        for i in range(12):              # the burn cleared: walk down
+            rung = lad.evaluate(now=10.0 + i)
+            if rung == 0:
+                break
+        if rung != 0:
+            problems.append("ladder did not walk back to rung 0 "
+                            "after the burn cleared")
+        if not np.array_equal(
+                dfleet.submit(pd_, n_new=8, tenant="chat",
+                              timeout=300), ref_full):
+            problems.append("post-recovery request still degraded "
+                            "(output not byte-identical to offline)")
+        # near-deadline interactive request: the front door hedges it
+        # onto the second warm replica — first completion wins, and
+        # once the race resolves launched == cancelled exactly
+        hh = dfleet.submit_async(pd_, n_new=8, tenant="chat",
+                                 deadline_s=30.0)
+        if not np.array_equal(hh.result(timeout=300), ref_full):
+            problems.append("hedged request output mismatch")
+        hedge_by = time.monotonic() + 30
+        while time.monotonic() < hedge_by:
+            if (hlaunch.value - hl0 >= 1
+                    and hcancel.value - hc0 == hlaunch.value - hl0):
+                break
+            time.sleep(0.01)
+        if hlaunch.value - hl0 < 1:
+            problems.append("near-deadline request launched no hedge")
+        elif hcancel.value - hc0 != hlaunch.value - hl0:
+            problems.append(
+                "hedge race left unresolved: launched "
+                f"{hlaunch.value - hl0} != cancelled "
+                f"{hcancel.value - hc0}")
+        # let the recorder witness the recovered rung before the
+        # replay reads the history
+        time.sleep(1.3)
+    if outcome_total("expired") - exp_d0 != 0:
+        problems.append("interactive deadline misses during the "
+                        "overload storm")
+    # replay the ladder walk from the RECORDED history over /query:
+    # the rung the storm reached and the recovery to 0 must both be
+    # reproducible from the wire, not just from in-process state
+    deg_dir = tempfile.mkdtemp(prefix="chaos_degrade_")
+    telemetry.publish_beacon(deg_dir, "chaos", registry=registry)
+    frd = telemetry.FleetRegistry(deg_dir, stale_after_s=3600.0,
+                                  tsdb=tsdb)
+    with telemetry.start_metrics_server(frd, port=0) as dsrv:
+        qdoc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{dsrv.port}/query?"
+            f"series=fleet_degrade_rung&start={wall_deg0 - 2.0}&"
+            f"end={time.time() + 1.0}", timeout=5).read().decode())
+        rungs = [p[1] for r in qdoc.get("results", ())
+                 for p in r.get("points", ())]
+        if not rungs:
+            problems.append("/query returned no fleet_degrade_rung "
+                            f"history over the storm window ({qdoc})")
+        else:
+            if max(rungs) < 2:
+                problems.append(
+                    "recorded ladder walk never reached rung 2 (max "
+                    f"{max(rungs):.0f}) — inconsistent with the shed "
+                    "the storm observed")
+            if rungs[-1] != 0:
+                problems.append(
+                    "recorded ladder walk did not return to rung 0 "
+                    f"(last sample {rungs[-1]:.0f})")
+    shutil.rmtree(deg_dir, ignore_errors=True)
+
     # -- sanitizer: one deliberate nan trip so the series has a
     # labeled child on the wire (check_finite itself is unconditional
     # — DL4J_TPU_SANITIZE gates the CALL SITES, not the check) -------
@@ -955,6 +1108,9 @@ def main(min_history_s: float = 60.0) -> int:
                 "interactive tenant missed deadlines during the "
                 f"autoscale step load: {line}")
     required += ct.ANALYSIS_SERIES
+    # ISSUE 18: the overload storm's admission outcomes, ladder rung,
+    # hedge race counters and degrade/hedge flight events on the wire
+    required += ct.DEGRADE_SERIES
     required += ['sanitizer_trips_total{mode="nan"}']
     # ISSUE 13: the prediction gauges the step-load scenario drove,
     # and the optimizer-step device-phase samples the pipeline chaos

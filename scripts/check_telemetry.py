@@ -207,6 +207,26 @@ SLO_SERIES = [
     'to="firing",host="fleet"} 1',
 ]
 
+# Production front door (ISSUE 18): the smoke below induces a REAL
+# overload (100%-bad tenant traffic aged past the long burn window
+# through a real AlertEngine), lets the attached DegradeLadder walk a
+# real fleet up to rung 4 (admissions shaped, the batch class shed
+# with a typed retry-after) and back to 0, and races one deadline'd
+# request's hedge on the second replica — so the admission outcome
+# counters, the rung gauge, the hedge race counters and the
+# degrade-step flight events all carry live values on the wire.
+DEGRADE_SERIES = [
+    'fleet_admission_admitted_total{tenant="chat"}',
+    'fleet_admission_degraded_total{tenant="chat"}',
+    'fleet_admission_rejected_total{tenant="bulk"}',
+    "fleet_degrade_rung",
+    "fleet_hedges_launched_total",
+    "fleet_hedges_won_total",
+    "fleet_hedges_cancelled_total",
+    'flight_events_total{kind="degrade_step"}',
+    'flight_events_total{kind="hedge"}',
+]
+
 # Mesh-sharded serving (ISSUE 17): the smoke below decodes one prompt
 # through a tp=2 replica spanning two virtual devices — byte-compared
 # against the single-chip server — and constructs a mixed fleet, so
@@ -581,6 +601,86 @@ def main() -> int:
             "tracked spans left open after every request retired: "
             f"{[s.name for s in tracer.open_spans()]}")
 
+    # -- production front door (ISSUE 18): induce a REAL overload —
+    # all-bad tenant traffic aged past the long burn window drives
+    # the engine's admission projection, the attached ladder walks a
+    # real 2-replica fleet to rung 4 (budgets capped, batch shed with
+    # retry-after) and back down once the burn clears, and a
+    # deadline'd request under hedge_slack_s races a hedge ---------
+    from deeplearning4j_tpu.serving import (AdmissionRejectedError,
+                                            DegradeLadder, TenantQuota)
+    from deeplearning4j_tpu.telemetry.slo import AlertEngine, SLOSpec
+    dreg = telemetry.MetricsRegistry()
+    dfam = dreg.counter("fleet_requests_total",
+                        labelnames=("tenant", "outcome"))
+    deg_eng = AlertEngine(
+        [SLOSpec("smoke-degrade", target=0.9, tenant="bulk",
+                 window_s=600.0, windows=[(0.1, 0.3, 1.5, "page")])],
+        source=dreg, registry=telemetry.MetricsRegistry())
+    deg_eng.evaluate(now=0.0)            # prime the history
+    for t in (0.2, 0.4, 0.6):            # 100% bad, past the 0.3s
+        dfam.labels(tenant="bulk", outcome="failed").inc(5)
+        deg_eng.evaluate(now=t)          # long window: burn 10x
+    hlaunch = registry.counter("fleet_hedges_launched_total")
+    hcancel = registry.counter("fleet_hedges_cancelled_total")
+    hl0, hc0 = hlaunch.value, hcancel.value
+    with ServingFleet(gpt, n_replicas=2, n_slots=2, max_len=32,
+                      block_size=4, tick_batch=1, tick_timeout_s=None,
+                      hedge_slack_s=60.0,
+                      quotas={"bulk": TenantQuota(klass="batch")}
+                      ) as dfleet:
+        lad = DegradeLadder(dfleet, deg_eng,
+                            thresholds=(1.0, 2.0, 3.0, 4.0),
+                            hold_down_s=0.0)
+        dfleet.attach_degrade(lad)
+        rung = lad.evaluate(now=0.6)     # real projection read
+        if rung != 4:
+            problems.append(f"induced 10x burn drove the ladder to "
+                            f"rung {rung}, expected 4")
+        try:
+            dfleet.submit_async(np.asarray([1, 2, 3], np.int32), 4,
+                                tenant="bulk")
+            problems.append("batch tenant admitted during the "
+                            "overload (rung 4 must shed)")
+        except AdmissionRejectedError as e:
+            if not e.retry_after_s > 0:
+                problems.append("shed batch tenant carried no "
+                                "retry_after_s hint")
+        deg_out = dfleet.submit(np.asarray([5, 6, 7], np.int32), 8,
+                                tenant="chat", timeout=300)
+        if deg_out.shape != (5,):        # n_new 8 -> capped 2
+            problems.append(f"rung 4 did not cap n_new: shape "
+                            f"{deg_out.shape}, expected (5,)")
+        for i in range(12):              # the burn cleared: walk down
+            rung = lad.evaluate(now=10.0 + i)
+            if rung == 0:
+                break
+        if rung != 0:
+            problems.append("ladder did not walk back to rung 0 "
+                            "after the burn cleared")
+        full_out = dfleet.submit(np.asarray([5, 6, 7], np.int32), 8,
+                                 tenant="chat", timeout=300)
+        if full_out.shape != (11,):
+            problems.append("post-recovery request still degraded: "
+                            f"shape {full_out.shape}, expected (11,)")
+        hh = dfleet.submit_async(np.asarray([1, 2, 3, 4], np.int32),
+                                 8, tenant="chat", deadline_s=30.0)
+        hh.result(timeout=300)
+        hedge_deadline = time.monotonic() + 30
+        while time.monotonic() < hedge_deadline:
+            if (hlaunch.value - hl0 >= 1
+                    and hcancel.value - hc0 == hlaunch.value - hl0):
+                break
+            time.sleep(0.01)
+        if hlaunch.value - hl0 < 1:
+            problems.append("deadline'd request under hedge_slack_s "
+                            "launched no hedge")
+        elif hcancel.value - hc0 != hlaunch.value - hl0:
+            problems.append(
+                "hedge race left unresolved: launched "
+                f"{hlaunch.value - hl0} != cancelled "
+                f"{hcancel.value - hc0}")
+
     # -- predictive autoscaling: a synthetic backlog ramp through the
     # REAL forecaster fit/publish path — the prediction gauges carry
     # live values on the scrape, and the math is checked against the
@@ -875,7 +975,8 @@ def main() -> int:
         "fleet_xprof_capture_files",
     ] + PAGED_KV_SERIES + TIERED_KV_SERIES + SPEC_SERIES \
       + FLEET_SERIES + RESILIENCE_SERIES + ANALYSIS_SERIES \
-      + FORECAST_SERIES + FLIGHT_SERIES + MESH_SERIES
+      + FORECAST_SERIES + FLIGHT_SERIES + MESH_SERIES \
+      + DEGRADE_SERIES
     problems += missing_series(body, required)
     if lat.count - lat_before != 16:
         problems.append(

@@ -709,6 +709,60 @@ class TestMeshSliceProbe:
         assert not any("_devices" in f.message for f in fs)
 
 
+class TestDegradeProbe:
+    """ISSUE 18: the degradation ladder's rung state
+    (``serving/degrade.py``, mutated by the evaluate loop while
+    admission threads read it through ``shape_admission``) and the
+    router's hedge racer (``_hedge_pass``, reading the in-flight list
+    the scheduler mutates) are cross-thread state — the CONC rules
+    must SEE both.  Probe pairs per :class:`TestKvTieringProbe`: the
+    shipped modules' lock discipline is clean, and stripping a lock
+    re-surfaces violations."""
+
+    LADDER = os.path.join(REPO, "deeplearning4j_tpu", "serving",
+                          "degrade.py")
+    ROUTER = os.path.join(REPO, "deeplearning4j_tpu", "serving",
+                          "router.py")
+
+    def test_shipped_ladder_is_conc_clean(self):
+        src = open(self.LADDER).read()
+        fs = concurrency_lint.lint_source(
+            src, "deeplearning4j_tpu/serving/degrade.py")
+        assert fs == [], [f.render() for f in fs]
+
+    def test_rules_see_rung_state_when_unguarded(self):
+        # strip the guard from the public ``state`` reader only:
+        # ``evaluate`` keeps its locked stores, so the rung state
+        # stays lock-guarded — the now-bare reads must surface as
+        # CONC202, proving the rules see the ladder's shared state
+        head, _, tail = open(self.LADDER).read().partition("def state")
+        src = head + "def state" + tail.replace("with self._lock:",
+                                                "if True:", 1)
+        fs = concurrency_lint.lint_source(
+            src, "deeplearning4j_tpu/serving/degrade.py")
+        hits = [f for f in fs if f.rule in ("CONC201", "CONC202")
+                and "_rung" in f.message]
+        assert hits, ("CONC rules are blind to the ladder's rung "
+                      f"state: {[f.render() for f in fs]}")
+
+    def test_rules_see_hedge_racer_when_unguarded(self):
+        # strip both lock regions from the hedge pass only: the
+        # now-bare reads of the scheduler-guarded in-flight list must
+        # surface as CONC202 IN _hedge_pass — the rules see the racer
+        # rather than skipping the module
+        head, _, tail = open(self.ROUTER).read().partition(
+            "def _hedge_pass")
+        src = head + "def _hedge_pass" + tail.replace(
+            "with self._lock:", "if True:", 2)
+        fs = concurrency_lint.lint_source(
+            src, "deeplearning4j_tpu/serving/router.py")
+        hits = [f for f in fs if f.rule in ("CONC201", "CONC202")
+                and f.symbol == "ServingFleet._hedge_pass"
+                and "_inflight" in f.message]
+        assert hits, ("CONC rules are blind to the hedge racer: "
+                      f"{[f.render() for f in fs]}")
+
+
 # ---------------------------------------------------------------------------
 # whole-package: index, cross-module rules, cache
 # ---------------------------------------------------------------------------

@@ -1,0 +1,406 @@
+"""Production front door (ISSUE 18): admission-time SLO projection,
+the graceful-degradation ladder, and tail-latency hedging.
+
+Pure-host pieces first (the rung transition matrix with an injected
+clock/burn, the hysteresis no-flap property, admission shaping, the
+coverage gate on the engine's projection, the retry-after floor on
+``submit(retries=)``), then — ``@slow`` per the saturated tier-1
+budget — the fleet integrations: rung reversibility is BYTE parity
+(post-recovery outputs identical to a never-degraded run) and the
+hedge race resolves first-wins with the loser cancelled and counted.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.models.generation import TransformerGenerator
+from deeplearning4j_tpu.serving import (AdmissionRejectedError,
+                                        DegradeLadder, RUNGS,
+                                        ServingFleet, TenantQuota)
+from deeplearning4j_tpu.telemetry import MetricsRegistry
+from deeplearning4j_tpu.telemetry.slo import AlertEngine, SLOSpec
+from deeplearning4j_tpu.zoo.gpt import Gpt
+
+
+def _tiny_gpt(**kw):
+    cfg = dict(vocab_size=50, max_len=32, d_model=32, n_layers=2,
+               n_heads=4, d_ff=64, seq_len=8, compute_dtype=None,
+               seed=3)
+    cfg.update(kw)
+    return Gpt(**cfg).init_graph()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _tiny_gpt()
+
+
+@pytest.fixture(scope="module")
+def offline(net):
+    return TransformerGenerator(net)
+
+
+def _counter(name: str) -> float:
+    return telemetry.get_registry().counter(name).value
+
+
+def _tenant_total(name: str) -> float:
+    fam = telemetry.get_registry().counter(name,
+                                           labelnames=("tenant",))
+    return sum(c.value for _vals, c in fam._items())
+
+
+# ---------------------------------------------------------------------------
+# the ladder state machine, pure host
+# ---------------------------------------------------------------------------
+def test_ladder_validation():
+    with pytest.raises(ValueError, match="thresholds"):
+        DegradeLadder(thresholds=(1.0, 2.0, 3.0))        # one short
+    with pytest.raises(ValueError, match="strictly increase"):
+        DegradeLadder(thresholds=(1.0, 3.0, 2.0, 4.0))
+    with pytest.raises(ValueError, match="hysteresis"):
+        DegradeLadder(hysteresis=1.5)                    # flaps
+    with pytest.raises(ValueError, match="n_new_factor"):
+        DegradeLadder(n_new_factor=0.0)
+
+
+def test_rung_transition_matrix_injected_clock():
+    """Ascent is immediate (a spike through two thresholds lands two
+    rungs in ONE pass); descent releases one rung only after burn sat
+    below hysteresis x the rung's own entry threshold for hold_down_s
+    — and the clock re-arms per rung."""
+    lad = DegradeLadder(thresholds=(1.0, 2.0, 3.0, 4.0),
+                        hysteresis=0.5, hold_down_s=10.0)
+    assert lad.evaluate(now=0.0, burn=0.5) == 0
+    assert lad.evaluate(now=1.0, burn=2.5) == 2     # 2-rung jump
+    assert lad.evaluate(now=2.0, burn=5.0) == 4     # spike to the top
+    # release point for rung 4 is 4.0 * 0.5 = 2.0: burn 3.0 is below
+    # the ENTRY threshold but above the release — no descent clock
+    assert lad.evaluate(now=3.0, burn=3.0) == 4
+    assert lad.evaluate(now=4.0, burn=1.0) == 4     # clock starts
+    assert lad.evaluate(now=13.0, burn=1.0) == 4    # 9s < hold_down
+    assert lad.evaluate(now=14.0, burn=1.0) == 3    # released ONE
+    # the clock RE-ARMED at the release: rung 3 (release 1.5) needs
+    # its own 10s below before the next step down
+    assert lad.evaluate(now=23.0, burn=1.0) == 3
+    assert lad.evaluate(now=24.5, burn=1.0) == 2
+    st = lad.state()
+    assert st["rung"] == 2 and st["name"] == RUNGS[2]
+    assert st["transitions"] == {
+        "enter:shrink_budget": 1, "enter:force_greedy": 1,
+        "enter:spec_off": 1, "enter:shed_batch": 1,
+        "exit:shed_batch": 1, "exit:spec_off": 1}
+
+
+def test_hysteresis_never_flaps():
+    """Load oscillating tightly around an entry threshold must enter
+    ONCE and never exit-re-enter: the release point sits hysteresis
+    below entry, so the low half of the oscillation never starts the
+    descent clock."""
+    lad = DegradeLadder(thresholds=(4.0, 6.0, 8.0, 10.0),
+                        hysteresis=0.7, hold_down_s=1.0)
+    for i in range(50):
+        burn = 4.1 if i % 2 == 0 else 3.9       # straddles 4.0
+        lad.evaluate(now=float(i), burn=burn)   # release is 2.8
+    st = lad.state()
+    assert st["rung"] == 1
+    assert st["transitions"] == {"enter:shrink_budget": 1}
+
+
+def test_policy_nests_and_shapes_admission():
+    """Rung N's policy includes every rung below it, and admission
+    shaping matches: budgets cap at rung 1, sampling goes greedy at
+    rung 2, the batch class rejects at rung 4 — interactive tenants
+    are shaped but NEVER rejected."""
+    lad = DegradeLadder(thresholds=(1.0, 2.0, 3.0, 4.0),
+                        n_new_factor=0.25, batch_tenants=("bulk",))
+    assert lad.policy(0) == {"max_n_new_factor": None, "min_n_new": 1,
+                             "force_greedy": False, "spec": True,
+                             "shed_tenants": ()}
+    assert lad.policy(3) == {"max_n_new_factor": 0.25, "min_n_new": 1,
+                             "force_greedy": True, "spec": False,
+                             "shed_tenants": ()}
+    assert lad.policy(4)["shed_tenants"] == ("bulk",)
+    # rung 0: pass-through (the reversibility contract at admission)
+    assert lad.shape_admission("t", 8, {"temperature": 0.9}) == \
+        (8, {"temperature": 0.9}, "admit")
+    lad.evaluate(now=0.0, burn=2.5)              # rung 2
+    n, samp, verdict = lad.shape_admission("t", 8, {"temperature": 0.9})
+    assert (n, samp, verdict) == (2, {"temperature": 0.0}, "degraded")
+    # already-greedy tiny request is untouched: nothing to degrade
+    assert lad.shape_admission("t", 1, {"temperature": 0.0}) == \
+        (1, {"temperature": 0.0}, "admit")
+    lad.evaluate(now=1.0, burn=9.0)              # rung 4
+    assert lad.shape_admission("bulk", 8, None)[2] == "reject"
+    assert lad.shape_admission("t", 8, None)[2] == "degraded"
+
+
+def test_shed_set_reads_accountant_batch_class():
+    """Without an explicit shed list the ladder sheds the fleet
+    accountant's EXPLICITLY-quota'd batch-class tenants — the default
+    quota's class never makes unknown tenants sheddable."""
+    class _F:
+        pass
+    from deeplearning4j_tpu.serving import TenantAccountant
+    f = _F()
+    f._acct = TenantAccountant(
+        default_quota=TenantQuota(klass="batch"),
+        quotas={"bulk": TenantQuota(klass="batch"),
+                "chat": TenantQuota(klass="interactive")})
+    assert DegradeLadder(fleet=f).shed_tenants() == ("bulk",)
+    assert DegradeLadder(fleet=f,
+                         batch_tenants=("x",)).shed_tenants() == ("x",)
+    assert DegradeLadder().shed_tenants() == ()
+
+
+# ---------------------------------------------------------------------------
+# admission projection on the real engine: the coverage gate
+# ---------------------------------------------------------------------------
+def _admission_engine(tenant="b", windows=((10.0, 30.0, 2.0, "page"),)):
+    src = MetricsRegistry()
+    src.counter("fleet_requests_total", labelnames=("tenant", "outcome"))
+    spec = SLOSpec("adm-avail", objective="availability", target=0.9,
+                   tenant=tenant, window_s=100.0,
+                   windows=[tuple(w) for w in windows])
+    return AlertEngine([spec], source=src,
+                       registry=MetricsRegistry()), src
+
+
+def _feed(src, good=0.0, bad=0.0, tenant="b"):
+    fam = src.counter("fleet_requests_total",
+                      labelnames=("tenant", "outcome"))
+    if good:
+        fam.labels(tenant=tenant, outcome="admitted").inc(good)
+    if bad:
+        fam.labels(tenant=tenant, outcome="failed").inc(bad)
+
+
+def test_admission_young_history_admits_everything():
+    """The coverage gate: until the history spans the LONG burn
+    window, the projection is (0, uncovered) and admission can never
+    reject — 100%-bad traffic included.  The same first-blip
+    discipline the multi-window alert shape has."""
+    eng, src = _admission_engine()
+    eng.evaluate(now=0.0)                        # prime
+    _feed(src, bad=10)                           # all bad, young store
+    eng.evaluate(now=10.0)
+    assert eng.projection(now=10.0)[0]["covered"] is False
+    v = eng.admission_decision("b", now=10.0)
+    assert v["decision"] == "admit"
+
+
+def test_admission_rejects_on_covered_overdraft_with_retry_slope():
+    """Aged past the long window with the budget overdrawn, the
+    tenant-named spec rejects; retry_after_s follows the recovery
+    slope (window_s * deficit / spent) clamped to [shortest burn
+    window, window_s].  Tenants the spec does not name stay
+    admitted."""
+    eng, src = _admission_engine()
+    eng.evaluate(now=0.0)
+    for t in (10.0, 20.0, 30.0):
+        _feed(src, bad=10)
+        eng.evaluate(now=t)
+    row = eng.projection(now=30.0)[0]
+    assert row["covered"] is True
+    # 100% bad vs 10% budget: burn 10x on both windows, flat trend
+    assert row["projected_burn"] == pytest.approx(10.0)
+    v = eng.admission_decision("b", now=30.0)
+    assert v["decision"] == "reject" and v["slo"] == "adm-avail"
+    assert 10.0 <= v["retry_after_s"] <= 100.0
+    assert v["projected_burn"] == pytest.approx(10.0)
+    assert eng.admission_decision("other", now=30.0)["decision"] == \
+        "admit"
+
+
+def test_tenantless_spec_degrades_but_never_rejects():
+    """A fleet-wide (tenant-less) SLO can only ever DEGRADE: shared
+    pain shapes everyone, it does not single anyone out for
+    rejection."""
+    eng, src = _admission_engine(tenant=None)
+    eng.evaluate(now=0.0)
+    for t in (10.0, 20.0, 30.0):
+        _feed(src, bad=10, tenant="whoever")
+        eng.evaluate(now=t)
+    v = eng.admission_decision("whoever", now=30.0)
+    assert v["decision"] == "degrade"
+    assert v["projected_burn"] == pytest.approx(10.0)
+
+
+# ---------------------------------------------------------------------------
+# submit(retries=) honors retry_after_s as the backoff floor
+# ---------------------------------------------------------------------------
+def test_submit_retry_floors_backoff_at_retry_after():
+    """The pinned satellite: a rejected-then-admitted submit sleeps at
+    LEAST the server-advised retry_after_s even though the fleet's
+    base backoff (0.01s) would never reach it — and with retries=0
+    the typed rejection propagates untouched."""
+    class _Handle:
+        def result(self, timeout=None):
+            return np.asarray([1, 2, 3], np.int32)
+
+    class _Stub:
+        retry_backoff_s = 0.01
+
+        def __init__(self):
+            self.calls = 0
+
+        def submit_async(self, *a, **kw):
+            self.calls += 1
+            if self.calls == 1:
+                raise AdmissionRejectedError("b", 0.25, 5.0)
+            return _Handle()
+
+    stub = _Stub()
+    with pytest.raises(AdmissionRejectedError) as ei:
+        ServingFleet.submit(stub, [1], 4, retries=0)
+    assert ei.value.retry_after_s == 0.25
+    assert ei.value.projected_burn == 5.0 and ei.value.tenant == "b"
+    stub = _Stub()
+    t0 = time.monotonic()
+    out = ServingFleet.submit(stub, [1], 4, retries=2)
+    assert time.monotonic() - t0 >= 0.25        # floored, not jittered
+    assert stub.calls == 2
+    np.testing.assert_array_equal(out, [1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# fleet integration: the reject is zero-cost
+# ---------------------------------------------------------------------------
+class _RejectingEngine:
+    """Stub engine: rejects tenant ``b`` with a fixed retry-after,
+    admits everyone else (duck-typed admission_decision only)."""
+
+    def admission_decision(self, tenant, now=None):
+        if tenant == "b":
+            return {"decision": "reject", "retry_after_s": 0.5,
+                    "projected_burn": 14.4, "slo": "stub"}
+        return {"decision": "admit", "retry_after_s": 0.0,
+                "projected_burn": 0.0, "slo": None}
+
+
+def test_front_door_reject_is_zero_cost(net):
+    """An admission reject burns NOTHING: no quota reserve, no wait
+    line entry, no replica state — and the typed error carries the
+    projection.  admission_control stays opt-in: the same engine
+    attached without the flag rejects nobody."""
+    rej0 = _tenant_total("fleet_admission_rejected_total")
+    with ServingFleet(net, n_replicas=1, n_slots=2, max_len=32,
+                      block_size=4, tick_batch=1, tick_timeout_s=None,
+                      slo_engine=_RejectingEngine(),
+                      admission_control=True) as fleet:
+        with pytest.raises(AdmissionRejectedError) as ei:
+            fleet.submit_async(np.asarray([1, 2, 3], np.int32), 4,
+                               tenant="b")
+        assert ei.value.retry_after_s == 0.5
+        assert ei.value.projected_burn == 14.4
+        st = fleet.stats()
+        assert st["waiting"] == 0 and st["inflight"] == 0
+        assert "b" not in st["tenants"]          # no reserve happened
+    assert _tenant_total("fleet_admission_rejected_total") - rej0 == 1.0
+    with ServingFleet(net, n_replicas=1, n_slots=2, max_len=32,
+                      block_size=4, tick_batch=1, tick_timeout_s=None,
+                      slo_engine=_RejectingEngine()) as fleet:
+        h = fleet.submit_async(np.asarray([1, 2, 3], np.int32), 4,
+                               tenant="b")       # opt-in flag off
+        h.cancel()
+    assert _tenant_total("fleet_admission_rejected_total") - rej0 == 1.0
+
+
+# ---------------------------------------------------------------------------
+# @slow fleet integrations: reversibility is byte parity; the hedge
+# race resolves first-wins
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_ladder_reversibility_byte_parity(net, offline):
+    """Every rung is REVERSIBLE: while rung 4 holds, admissions are
+    shaped (budget capped, sampling forced greedy, batch shed with a
+    typed retry-after) and the shaped outputs equal offline at the
+    SHAPED budget; after the burn clears and the ladder walks back to
+    0, a fresh request's bytes are identical to a never-degraded
+    run."""
+    p = np.arange(1, 14, dtype=np.int32)
+    ref_full = offline.generate(p[None], n_new=8)[0]
+    ref_capped = offline.generate(p[None], n_new=2)[0]
+    deg0 = _tenant_total("fleet_admission_degraded_total")
+    with ServingFleet(net, n_replicas=1, n_slots=2, max_len=32,
+                      block_size=4, tick_batch=1,
+                      tick_timeout_s=None,
+                      quotas={"bulk": TenantQuota(klass="batch")}
+                      ) as fleet:
+        lad = DegradeLadder(fleet, thresholds=(1.0, 2.0, 3.0, 4.0),
+                            hold_down_s=0.0, n_new_factor=0.25)
+        fleet.attach_degrade(lad)
+        assert lad.evaluate(now=0.0, burn=10.0) == 4
+        # batch class sheds with the ladder's retry-after hint
+        with pytest.raises(AdmissionRejectedError) as ei:
+            fleet.submit_async(p, 8, tenant="bulk")
+        assert ei.value.retry_after_s == lad.shed_retry_after_s
+        # interactive work is shaped, not shed: n_new 8 -> 2, and a
+        # SAMPLED request decodes greedy (same bytes) while the rung
+        # holds
+        np.testing.assert_array_equal(
+            fleet.submit(p, 8, tenant="chat", timeout=300),
+            ref_capped)
+        np.testing.assert_array_equal(
+            fleet.submit(p, 8, tenant="chat", timeout=300,
+                         sampling={"temperature": 0.9}),
+            ref_capped)
+        assert _tenant_total("fleet_admission_degraded_total") - deg0 >= 2
+        # the burn clears: one rung per pass with hold_down_s=0
+        walked = []
+        while True:
+            r = lad.evaluate(now=1000.0, burn=0.0)
+            walked.append(r)
+            if r == 0:
+                break
+            assert len(walked) < 20
+        assert lad.rung() == 0
+        assert lad.state()["transitions"]["exit:shed_batch"] == 1
+        # post-recovery: byte-identical to never-degraded, spec and
+        # sampling restored, batch admitted again
+        np.testing.assert_array_equal(
+            fleet.submit(p, 8, tenant="chat", timeout=300), ref_full)
+        np.testing.assert_array_equal(
+            fleet.submit(p, 8, tenant="bulk", timeout=300), ref_full)
+
+
+@pytest.mark.slow
+def test_hedge_first_wins_and_loser_cancelled(net, offline):
+    """A deadline-carrying interactive request under hedge_slack_s
+    duplicates onto the second replica and the race resolves
+    FIRST-WINS: the winner's bytes equal offline ``generate()``
+    (greedy — both placements decode the same bytes, so whoever wins
+    the caller sees the right answer), the loser is cancelled, and
+    the counters settle at launched == cancelled with won <= launched.
+    A request with no deadline never hedges."""
+    p = np.arange(1, 10, dtype=np.int32)
+    ref = offline.generate(p[None], n_new=10)[0]
+    l0 = _counter("fleet_hedges_launched_total")
+    w0 = _counter("fleet_hedges_won_total")
+    c0 = _counter("fleet_hedges_cancelled_total")
+    with ServingFleet(net, n_replicas=2, n_slots=2, max_len=32,
+                      block_size=4, tick_batch=1, tick_timeout_s=None,
+                      hedge_slack_s=60.0) as fleet:
+        h = fleet.submit_async(p, 10, deadline_s=30.0)
+        np.testing.assert_array_equal(h.result(timeout=300), ref)
+        # the race fully resolves: exactly one launch, exactly one
+        # cancel (whichever side lost), a win only if the hedge beat
+        # the primary
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if (_counter("fleet_hedges_cancelled_total") - c0
+                    == _counter("fleet_hedges_launched_total") - l0):
+                break
+            time.sleep(0.01)
+        launched = _counter("fleet_hedges_launched_total") - l0
+        won = _counter("fleet_hedges_won_total") - w0
+        cancelled = _counter("fleet_hedges_cancelled_total") - c0
+        assert launched == 1.0
+        assert cancelled == launched
+        assert won in (0.0, 1.0)
+        # no deadline -> no hedge, whatever the budget allows
+        np.testing.assert_array_equal(
+            fleet.submit(p, 10, timeout=300), ref)
+        assert _counter("fleet_hedges_launched_total") - l0 == launched
