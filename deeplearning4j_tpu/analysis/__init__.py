@@ -1,6 +1,6 @@
 """Static analysis + runtime sanitizers for the dl4j-tpu stack.
 
-Three passes over a shared findings model (see ISSUE/README "Static
+Four passes over a shared findings model (see ISSUE/README "Static
 analysis & sanitizers"):
 
 * :mod:`~deeplearning4j_tpu.analysis.jit_lint` — trace-safety (host
@@ -8,6 +8,9 @@ analysis & sanitizers"):
 * :mod:`~deeplearning4j_tpu.analysis.concurrency_lint` — lock
   discipline (guarded attributes accessed outside their lock on
   thread-reachable paths);
+* :mod:`~deeplearning4j_tpu.analysis.lock_order` — deadlock lint
+  (whole-package lock-order graph: ABBA cycles, lock-held blocking
+  calls, callback-table thread reachability);
 * :mod:`~deeplearning4j_tpu.analysis.graph_lint` — graph-IR validation
   (dead vertices, arity, symbolic-dim ``jax.eval_shape`` inference,
   f64 leaks).
@@ -17,15 +20,19 @@ builds a cross-module symbol table + call graph (imports, inheritance,
 lock provenance, ``Static``/``Traced``/class-typed annotations from
 :mod:`~deeplearning4j_tpu.analysis.annotations`) with a per-file-mtime
 on-disk cache; ``jit_lint.lint_package`` walks trace contexts through
-cross-module callees (JIT106) and ``concurrency_lint.lint_package``
+cross-module callees (JIT106), ``concurrency_lint.lint_package``
 checks module-level state and foreign lock-guarded attributes
-(CONC205/CONC206).
+(CONC205/CONC206), and ``lock_order.lint_package`` builds the
+interprocedural lock-order graph (CONC301/302/303) with thread roots
+seeded from ``Thread(target=...)`` spawns plus the entry calls of aux
+seed directories (``scripts/``).
 
 CLI: ``python -m deeplearning4j_tpu.analysis`` (see
 :mod:`~deeplearning4j_tpu.analysis.cli`); CI gate:
 ``scripts/lint_gate.py`` against ``ANALYSIS_BASELINE.json``
 (``--changed-only`` for pre-commit loops, ``--audit-baseline`` for
-debt hygiene).
+debt hygiene, ``--prune`` to retire fixed debt, ``--check`` to fail
+CI while pruneable stale keys remain).
 
 Runtime companion: :mod:`~deeplearning4j_tpu.analysis.sanitize`
 (``DL4J_TPU_SANITIZE=nan,donation``) dynamically confirms the two

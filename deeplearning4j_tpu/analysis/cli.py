@@ -17,10 +17,14 @@
         --cache=FILE / --no-cache per-file-mtime index cache (default
                                   .dl4j_lint_cache.json beside the
                                   baseline / under the linted package)
+        --seed-dir=DIR            extra aux directory (scripts/) whose
+                                  entry points seed the lock-order
+                                  pass's thread-reachability
 
 Default mode is WHOLE-PACKAGE: directory paths (and the no-path
 default, the installed package) are linted through the cross-module
-package index — per-module rules plus JIT106/CONC205/CONC206 over the
+package index — per-module rules plus JIT106/CONC205/CONC206 and the
+lock-order deadlock rules CONC301/302/303 over the
 package-wide call graph, with summaries and per-file findings cached
 by (mtime, size) so warm runs re-parse only what changed.  Explicit
 FILE paths fall back to per-module-only linting (a single file has no
@@ -89,11 +93,16 @@ def lint_paths(paths: Sequence[str], rules: Sequence[str] = ("jit", "conc"),
 def lint_package(pkg_dir: str, root: Optional[str] = None,
                  cache_path: Optional[str] = None,
                  rules: Sequence[str] = ("jit", "conc"),
-                 cross: bool = True):
+                 cross: bool = True,
+                 seed_dirs: Sequence[str] = ()):
     """Whole-package mode: per-module findings (cached per file) plus
-    the cross-module JIT106/CONC205/CONC206 passes over the package
-    index.  Returns ``(findings, stats)``."""
-    from deeplearning4j_tpu.analysis import package_index
+    the cross-module JIT106/CONC205/CONC206 and CONC301/302/303
+    passes over the package index.  Returns ``(findings, stats)``.
+
+    ``seed_dirs`` (e.g. ``scripts/``) are indexed WITHOUT local passes
+    and merged as aux modules: their entry points seed the lock-order
+    pass's thread-reachability, but no findings are reported in them."""
+    from deeplearning4j_tpu.analysis import lock_order, package_index
     index, findings, stats = package_index.build_index(
         pkg_dir, root=root, cache_path=cache_path)
     if "jit" not in rules:
@@ -105,6 +114,22 @@ def lint_package(pkg_dir: str, root: Optional[str] = None,
             findings = findings + jit_lint.lint_package(index)
         if "conc" in rules:
             findings = findings + concurrency_lint.lint_package(index)
+            cross_index = index
+            if seed_dirs:
+                merged = dict(index.modules)
+                aux = set()
+                for d in seed_dirs:
+                    aux_idx, _, aux_st = package_index.build_index(
+                        d, root=root, cache_path=cache_path,
+                        run_local_passes=False)
+                    _merge_stats(stats, aux_st)
+                    for m, s in aux_idx.modules.items():
+                        if m not in merged:
+                            merged[m] = s
+                            aux.add(m)
+                cross_index = package_index.PackageIndex(merged,
+                                                         aux=aux)
+            findings = findings + lock_order.lint_package(cross_index)
     return findings, stats
 
 
@@ -169,6 +194,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          ".dl4j_lint_cache.json beside the baseline, "
                          "or under the linted directory)")
     ap.add_argument("--no-cache", action="store_true")
+    ap.add_argument("--seed-dir", action="append", default=[],
+                    help="extra directory (e.g. scripts/) indexed "
+                         "only to seed thread/entry-point "
+                         "reachability for the lock-order pass "
+                         "(repeatable; no findings reported in it)")
     args = ap.parse_args(argv)
 
     rules = [r.strip() for r in args.rules.split(",") if r.strip()]
@@ -201,7 +231,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     root or os.path.abspath(p))
             fs, st = lint_package(p, root=root, cache_path=cache,
                                   rules=rules,
-                                  cross=not args.no_cross)
+                                  cross=not args.no_cross,
+                                  seed_dirs=args.seed_dir)
             findings.extend(fs)
             stats = _merge_stats(stats, st)
         else:

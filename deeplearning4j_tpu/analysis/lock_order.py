@@ -1,0 +1,590 @@
+"""Whole-package deadlock lint: the lock-acquisition graph.
+
+PR 17/18 made the serving stack genuinely multi-threaded (fleet
+scheduler, degrade-ladder clock, hedge racer, TSDB recorder, beacon,
+watchdogs) — the point where per-region lock rules stop being enough.
+``concurrency_lint`` proves each *region* is consistent; nothing so
+far proves the regions compose: that no two threads ever acquire the
+same two locks in opposite orders, that nothing blocks indefinitely
+while holding a lock, and that a callback drained from a handler
+table does not re-enter a lock its invoking thread already holds.
+Those are exactly the properties ThreadSanitizer's lock-order
+-inversion detection and Eraser's lockset discipline check at runtime
+— this pass checks them statically, over
+:class:`~deeplearning4j_tpu.analysis.package_index.PackageIndex`'s
+whole-package call graph, so the CI gate proves the topology
+deadlock-free before any thread is ever started.
+
+Rules
+-----
+
+* **CONC301** (error) — cycle in the lock-order graph: lock A is held
+  while B is acquired on one path and B is held while A is acquired
+  on another.  Two threads interleaving those paths deadlock.  The
+  finding carries one witness per edge of the cycle.
+* **CONC302** (warning) — a blocking call (``Thread.join`` /
+  ``Queue.get`` / ``Future.result`` / ``Event.wait`` without timeout,
+  ``time.sleep`` at or above 50 ms, socket/HTTP I/O, subprocess
+  waits) executes while a lock is held — directly, or transitively
+  through any chain of calls the package index can resolve.  Every
+  other thread needing that lock stalls for the full blocking time.
+* **CONC303** (error) — a callback stored into a container (handler
+  table, sink list, actuator registry) is invoked by a thread holding
+  a lock the callback itself acquires.  The registration site hides
+  the acquisition from lexical review — the container data-flow makes
+  it part of the lock graph anyway.
+
+Lock identity is canonical across modules: ``self._lock`` folds to
+the base-most class in the MRO that constructs the attribute
+(``module::Class.attr``), module-level locks to ``module::NAME``
+through import aliases.  Edges whose lock cannot be canonicalized are
+dropped rather than guessed (an ambiguous ``other._lock`` must not
+fabricate a deadlock report).
+
+Thread roots are ``threading.Thread(target=...)`` spawns, public
+methods of lock-owning/thread-starting classes, and — when the caller
+indexes ``scripts/`` as aux seed modules — every function a script's
+module-level code reaches, closing the "bare entry points called only
+from scripts" blind spot carried since PR 8.  Aux modules only seed
+and route reachability; findings are never reported in them.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.analysis.findings import Finding
+
+#: acqstar/blockstar chains longer than this are rendered elided
+_CHAIN_LIMIT = 4
+
+
+def _short_lock(canon: str) -> str:
+    """``pkg.serving.router::ServingFleet._lock`` ->
+    ``router::ServingFleet._lock`` (messages are line-free AND
+    package-prefix-free so baseline keys survive moves of the tree)."""
+    mod, _, rest = canon.partition("::")
+    return f"{mod.rsplit('.', 1)[-1]}::{rest}"
+
+
+class _Pass:
+    def __init__(self, index):
+        self.index = index
+        self.fids = sorted(index.functions)
+        #: fid -> sorted unique resolved callees (with call lines)
+        self.calls: Dict[str, List[Tuple[int, List, str]]] = {}
+        #: fid -> canonical lock implicitly held on entry (the
+        #: ``*_locked`` suffix convention: caller holds the class lock)
+        self.implicit: Dict[str, Optional[str]] = {}
+        #: lock-order graph: a -> b -> witness dict
+        self.edges: Dict[str, Dict[str, Dict]] = {}
+        self.findings: List[Finding] = []
+        self._canon_cache: Dict[Tuple, Optional[str]] = {}
+        self._lock_attr_owners = self._collect_lock_attr_owners()
+
+    # -- lock identity --------------------------------------------------
+    def _collect_lock_attr_owners(self) -> Dict[str, List[Tuple[str, str]]]:
+        """lock attribute name -> classes that construct it (for the
+        unique-attribute fallback on untyped foreign bases)."""
+        out: Dict[str, List[Tuple[str, str]]] = {}
+        for mod in sorted(self.index.modules):
+            classes = self.index.modules[mod].get("classes", {})
+            for cname in sorted(classes):
+                for attr in classes[cname].get("lock_attrs", ()):
+                    out.setdefault(attr, []).append((mod, cname))
+        return out
+
+    def _canon_attr(self, mod: str, cls: str, attr: str) -> str:
+        """Fold ``Class.attr`` to the base-most MRO class constructing
+        it, so a subclass and its base name the SAME lock node."""
+        owner = (mod, cls)
+        for m, c in self.index.class_mro(mod, cls):
+            ci = self.index.modules.get(m, {}).get("classes", {}).get(c)
+            if ci and attr in ci.get("lock_attrs", ()):
+                owner = (m, c)          # MRO is subclass-first: keep last
+        return f"{owner[0]}::{owner[1]}.{attr}"
+
+    def canon_lock(self, mod: str, cls: Optional[str],
+                   parts: Sequence[str],
+                   base_type: Optional[Sequence[str]] = None
+                   ) -> Optional[str]:
+        key = (mod, cls, tuple(parts),
+               tuple(base_type) if base_type else None)
+        if key in self._canon_cache:
+            return self._canon_cache[key]
+        self._canon_cache[key] = out = self._canon_lock(
+            mod, cls, list(parts), base_type)
+        return out
+
+    def _canon_lock(self, mod, cls, parts, base_type):
+        if not parts:
+            return None
+        attr = parts[-1]
+        if parts[0] in ("self", "cls") and len(parts) == 2 and cls:
+            return self._canon_attr(mod, cls, attr)
+        s = self.index.modules.get(mod, {})
+        if len(parts) == 1:
+            if parts[0] in s.get("module_locks", ()) or \
+                    parts[0] in s.get("module_state", {}):
+                return f"{mod}::{parts[0]}"
+            hop = self.index.resolve_import(mod, parts[0])
+            if hop is not None and hop[1] is not None and \
+                    hop[0] in self.index.modules:
+                tmod, tname = hop
+                ts = self.index.modules[tmod]
+                if tname in ts.get("module_locks", ()) or \
+                        tname in ts.get("module_state", {}):
+                    return f"{tmod}::{tname}"
+            # a function-local lock: per-call-frame, orders with
+            # nothing across threads by identity we can prove — skip
+            return None
+        if base_type is not None:
+            hit = self.index.resolve_class(mod, list(base_type))
+            if hit is not None:
+                return self._canon_attr(hit[0], hit[1], attr)
+        owners = self._lock_attr_owners.get(attr, [])
+        if len(owners) == 1:
+            return self._canon_attr(owners[0][0], owners[0][1], attr)
+        return None
+
+    def canon_container(self, mod: str, cls: Optional[str],
+                        parts: Sequence[str]) -> Optional[str]:
+        """Callback-container identity; same shape as locks but folded
+        over guarded/typed attribute declarations."""
+        parts = list(parts)
+        if parts and parts[0] in ("self", "cls") and \
+                len(parts) == 2 and cls:
+            attr = parts[1]
+            owner = (mod, cls)
+            for m, c in self.index.class_mro(mod, cls):
+                ci = self.index.modules.get(m, {}) \
+                    .get("classes", {}).get(c)
+                if ci and (attr in ci.get("guarded", ()) or
+                           attr in ci.get("attr_types", {})):
+                    owner = (m, c)
+            return f"{owner[0]}::{owner[1]}.{attr}"
+        if len(parts) == 1 and \
+                parts[0] in self.index.modules.get(mod, {}) \
+                .get("module_state", {}):
+            return f"{mod}::{parts[0]}"
+        return None
+
+    # -- per-function fact preparation ---------------------------------
+    def _fn_ctx(self, fid: str) -> Tuple[str, Optional[str], Dict]:
+        mod = self.index.func_module[fid]
+        fn = self.index.functions[fid]
+        return mod, fn.get("cls"), fn
+
+    def _implicit_lock(self, fid: str) -> Optional[str]:
+        mod, cls, fn = self._fn_ctx(fid)
+        qn = fid.split("::", 1)[1]
+        if not qn.rsplit(".", 1)[-1].endswith("_locked") or not cls:
+            return None
+        facts = self.index.class_facts(mod, cls)
+        locks = sorted(facts["lock_attrs"])
+        if len(locks) == 1:
+            return self._canon_attr(mod, cls, locks[0])
+        return None
+
+    def _held_canons(self, fid: str, raw_held: Sequence[Sequence[str]]
+                     ) -> List[str]:
+        mod, cls, _ = self._fn_ctx(fid)
+        out: List[str] = []
+        for parts in raw_held:
+            c = self.canon_lock(mod, cls, parts)
+            if c is not None and c not in out:
+                out.append(c)
+        imp = self.implicit.get(fid)
+        if imp is not None and imp not in out:
+            out.append(imp)
+        return out
+
+    def _resolved_calls(self, fid: str) -> List[Tuple[int, List, str]]:
+        """[(line, held_canons, callee_fid)] — deterministic order."""
+        if fid in self.calls:
+            return self.calls[fid]
+        mod, cls, fn = self._fn_ctx(fid)
+        out: List[Tuple[int, List, str]] = []
+        for call in fn.get("calls", ()):
+            held = self._held_canons(fid, call.get("locks", ()))
+            for callee in sorted(set(self.index.resolve_call(fid, call))):
+                out.append((call.get("line", 0), held, callee))
+        for qn in fn.get("nested", ()):
+            nfid = f"{mod}::{qn}"
+            if nfid in self.index.functions:
+                out.append((fn.get("line", 0), [], nfid))
+        self.calls[fid] = out
+        return out
+
+    def _fn_name(self, fid: str) -> str:
+        mod, qn = fid.split("::", 1)
+        return f"{mod.rsplit('.', 1)[-1]}.{qn}"
+
+    # -- fixpoints ------------------------------------------------------
+    def _acqstar(self) -> Dict[str, Dict[str, Tuple[int, str]]]:
+        """fid -> lock -> (depth, via-chain) for every lock the
+        function acquires itself or through any resolvable callee.
+        Deterministic: merges prefer smaller (depth, chain)."""
+        acq: Dict[str, Dict[str, Tuple[int, str]]] = {}
+        for fid in self.fids:
+            mod, cls, fn = self._fn_ctx(fid)
+            direct: Dict[str, Tuple[int, str]] = {}
+            for line, parts, base_t, _held in fn.get("acquires", ()):
+                c = self.canon_lock(mod, cls, parts, base_t)
+                if c is not None:
+                    direct.setdefault(c, (0, ""))
+            acq[fid] = direct
+        changed = True
+        while changed:
+            changed = False
+            for fid in self.fids:
+                cur = acq[fid]
+                for _line, _held, callee in self._resolved_calls(fid):
+                    for lock, (d, via) in acq.get(callee, {}).items():
+                        cand = (d + 1,
+                                self._fn_name(callee) +
+                                (" -> " + via if via else ""))
+                        if cand[0] > _CHAIN_LIMIT * 4:
+                            continue
+                        old = cur.get(lock)
+                        if old is None or cand < old:
+                            cur[lock] = cand
+                            changed = True
+        return acq
+
+    def _blockstar(self) -> Dict[str, Tuple[int, str, str]]:
+        """fid -> nearest (depth, detail, via-chain) blocking call the
+        function reaches, itself included."""
+        blk: Dict[str, Tuple[int, str, str]] = {}
+        for fid in self.fids:
+            _mod, _cls, fn = self._fn_ctx(fid)
+            best: Optional[Tuple[int, str, str]] = None
+            for _line, detail, _parts, _held in fn.get("blocking", ()):
+                cand = (0, detail, "")
+                if best is None or cand < best:
+                    best = cand
+            if best is not None:
+                blk[fid] = best
+        changed = True
+        while changed:
+            changed = False
+            for fid in self.fids:
+                for _line, _held, callee in self._resolved_calls(fid):
+                    hit = blk.get(callee)
+                    if hit is None:
+                        continue
+                    d, detail, via = hit
+                    cand = (d + 1, detail,
+                            self._fn_name(callee) +
+                            (" -> " + via if via else ""))
+                    if cand[0] > _CHAIN_LIMIT * 4:
+                        continue
+                    old = blk.get(fid)
+                    if old is None or cand < old:
+                        blk[fid] = cand
+                        changed = True
+        return blk
+
+    # -- graph ----------------------------------------------------------
+    def _add_edge(self, a: str, b: str, fid: str, line: int,
+                  via: str) -> None:
+        if a == b:
+            return                      # reentrant re-acquire (RLock)
+        slot = self.edges.setdefault(a, {})
+        if b not in slot:
+            slot[b] = {"fid": fid, "line": line, "via": via}
+
+    def run(self) -> List[Finding]:
+        for fid in self.fids:
+            self.implicit[fid] = self._implicit_lock(fid)
+        acq = self._acqstar()
+        blk = self._blockstar()
+
+        registrations = self._registrations()
+        reach = set(self.index.closure(
+            list(self.index.thread_seeds()) +
+            list(self.index.entry_seeds())))
+
+        seen302: Set[Tuple[str, str, str]] = set()
+        for fid in self.fids:
+            mod, cls, fn = self._fn_ctx(fid)
+            aux = self.index.is_aux(mod)
+            path = self.index.modules[mod]["path"]
+            qn = fid.split("::", 1)[1]
+
+            # direct with-nesting edges + edges through calls
+            for line, parts, base_t, raw_held in fn.get("acquires", ()):
+                inner = self.canon_lock(mod, cls, parts, base_t)
+                if inner is None:
+                    continue
+                for outer in self._held_canons(fid, raw_held):
+                    self._add_edge(outer, inner, fid, line, "")
+            for line, held, callee in self._resolved_calls(fid):
+                if not held:
+                    continue
+                for lock, (_d, via) in acq.get(callee, {}).items():
+                    chain = self._fn_name(callee) + \
+                        (" -> " + via if via else "")
+                    for outer in held:
+                        self._add_edge(outer, lock, fid, line, chain)
+                # CONC302: blocking reached through the call
+                hit = blk.get(callee)
+                if hit is not None and not aux:
+                    _d, detail, via = hit
+                    chain = self._fn_name(callee) + \
+                        (" -> " + via if via else "")
+                    for outer in held:
+                        key = (fid, outer, detail)
+                        if key in seen302:
+                            continue
+                        seen302.add(key)
+                        self.findings.append(Finding(
+                            rule="CONC302", severity="warning",
+                            path=path, line=line, symbol=qn,
+                            message=(f"call while holding "
+                                     f"'{_short_lock(outer)}' reaches "
+                                     f"blocking {detail} via {chain}"),
+                            fix_hint="bound the blocking call with a "
+                                     "timeout or move it outside the "
+                                     "lock region"))
+
+            # CONC302: lexically-direct blocking under a lock
+            for line, detail, parts, raw_held in fn.get("blocking", ()):
+                held = self._held_canons(fid, raw_held)
+                if not held or aux:
+                    continue
+                base = self.canon_lock(mod, cls, parts[:-1]) \
+                    if len(parts) > 1 else None
+                for outer in held:
+                    if base is not None and base == outer:
+                        # cond.wait() RELEASES the lock it waits on —
+                        # the canonical condition-variable pattern
+                        continue
+                    key = (fid, outer, detail)
+                    if key in seen302:
+                        continue
+                    seen302.add(key)
+                    self.findings.append(Finding(
+                        rule="CONC302", severity="warning",
+                        path=path, line=line, symbol=qn,
+                        message=(f"blocking {detail} while holding "
+                                 f"'{_short_lock(outer)}'"),
+                        fix_hint="bound the call with a timeout or "
+                                 "move it outside the lock region"))
+
+            # callbacks drained here: their acquisitions join the
+            # graph, and a held lock they re-acquire is CONC303
+            for line, cparts, raw_held in fn.get("cb_invokes", ()):
+                cont = self.canon_container(mod, cls, cparts)
+                if cont is None:
+                    continue
+                held = self._held_canons(fid, raw_held)
+                for reg_fid, cb_fid, reg_held in \
+                        registrations.get(cont, ()):
+                    cb_locks: Dict[str, str] = {}
+                    for lock, (_d, via) in acq.get(cb_fid, {}).items():
+                        cb_locks[lock] = via
+                    for lock, via in sorted(cb_locks.items()):
+                        chain = self._fn_name(cb_fid) + \
+                            (" -> " + via if via else "")
+                        for outer in held:
+                            self._add_edge(outer, lock, fid, line,
+                                           chain)
+                    if aux or fid not in reach:
+                        continue
+                    clash = sorted(set(held) & set(cb_locks))
+                    if not clash or set(held) == set(reg_held):
+                        continue
+                    lock = clash[0]
+                    self.findings.append(Finding(
+                        rule="CONC303", severity="error",
+                        path=path, line=line, symbol=qn,
+                        message=(f"callback "
+                                 f"'{self._fn_name(cb_fid)}' from "
+                                 f"'{_short_lock(cont)}' acquires "
+                                 f"'{_short_lock(lock)}' already held "
+                                 f"at this invocation (registered in "
+                                 f"{self._fn_name(reg_fid)} holding "
+                                 + (", ".join(_short_lock(h) for h in
+                                              reg_held)
+                                    if reg_held else "no locks") + ")"),
+                        fix_hint="snapshot the table and invoke the "
+                                 "callbacks after releasing the lock, "
+                                 "or make the callback lock-free"))
+
+        self._cycle_findings()
+        return self.findings
+
+    def _registrations(self) -> Dict[str, List[Tuple[str, str, List]]]:
+        """container canon -> [(registering fid, callback fid,
+        registration-held canons)]."""
+        out: Dict[str, List[Tuple[str, str, List]]] = {}
+        for fid in self.fids:
+            mod, cls, fn = self._fn_ctx(fid)
+            for _line, cparts, fparts, raw_held, via, base_t in \
+                    fn.get("cb_stores", ()):
+                cont = self.canon_container(mod, cls, cparts)
+                if cont is None and via:
+                    cont = self._forwarded_container(mod, cls, via,
+                                                     base_t)
+                if cont is None:
+                    continue
+                cands = self.index.resolve_in_module(
+                    mod, fparts, cls=cls)
+                held = self._held_canons(fid, raw_held)
+                for cb in sorted(set(cands)):
+                    out.setdefault(cont, []).append((fid, cb, held))
+        return out
+
+    def _forwarded_container(self, mod: str, cls: Optional[str],
+                             via: Sequence[str],
+                             base_t: Optional[Sequence[str]]
+                             ) -> Optional[str]:
+        """``bus.subscribe(cb)``: the table lives inside the callee —
+        find the cb_store in ``Bus.subscribe`` whose stored value is a
+        bare unresolvable name (the forwarded parameter) and
+        canonicalize THAT container."""
+        callees: List[str] = []
+        if base_t is not None:
+            hit = self.index.resolve_class(mod, list(base_t))
+            if hit is not None:
+                m = self.index.resolve_method(hit[0], hit[1], via[-1])
+                if m is not None:
+                    callees.append(m)
+        if not callees:
+            callees = sorted(set(
+                self.index.resolve_in_module(mod, list(via), cls=cls)))
+        for callee in callees:
+            cmod, ccls, cfn = self._fn_ctx(callee)
+            for _l, c2, f2, _h, _v, _b in cfn.get("cb_stores", ()):
+                if len(f2) == 1 and not self.index.resolve_in_module(
+                        cmod, f2, cls=ccls):
+                    cont = self.canon_container(cmod, ccls, c2)
+                    if cont is not None:
+                        return cont
+        return None
+
+    # -- cycles ---------------------------------------------------------
+    def _cycle_findings(self) -> None:
+        for scc in self._sccs():
+            cycle = self._cycle_path(scc)
+            if cycle is None:
+                continue
+            steps: List[str] = []
+            anchor: Optional[Tuple[str, int]] = None
+            for a, b in zip(cycle, cycle[1:]):
+                w = self.edges[a][b]
+                mod = self.index.func_module[w["fid"]]
+                if anchor is None and not self.index.is_aux(mod):
+                    anchor = (w["fid"], w["line"])
+                steps.append(
+                    f"'{_short_lock(a)}' held in "
+                    f"{self._fn_name(w['fid'])} while acquiring "
+                    f"'{_short_lock(b)}'"
+                    + (f" via {w['via']}" if w["via"] else ""))
+            if anchor is None:
+                continue            # cycle witnessed only in aux code
+            fid, line = anchor
+            mod = self.index.func_module[fid]
+            self.findings.append(Finding(
+                rule="CONC301", severity="error",
+                path=self.index.modules[mod]["path"], line=line,
+                symbol=fid.split("::", 1)[1],
+                message=("lock-order cycle " +
+                         " -> ".join(_short_lock(n) for n in cycle) +
+                         ": " + "; ".join(steps)),
+                fix_hint="pick one global acquisition order for these "
+                         "locks (or collapse them into one)"))
+
+    def _sccs(self) -> List[List[str]]:
+        """Tarjan SCCs of the lock graph, size >= 2 only, iterative,
+        deterministic (sorted roots/neighbors)."""
+        index_of: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        out: List[List[str]] = []
+        counter = [0]
+        nodes = sorted(set(self.edges) |
+                       {b for bs in self.edges.values() for b in bs})
+
+        def neighbors(n):
+            return sorted(self.edges.get(n, {}))
+
+        for root in nodes:
+            if root in index_of:
+                continue
+            work: List[Tuple[str, int]] = [(root, 0)]
+            while work:
+                node, pi = work[-1]
+                if pi == 0:
+                    index_of[node] = low[node] = counter[0]
+                    counter[0] += 1
+                    stack.append(node)
+                    on_stack.add(node)
+                recurse = False
+                ns = neighbors(node)
+                for j in range(pi, len(ns)):
+                    w = ns[j]
+                    if w not in index_of:
+                        work[-1] = (node, j + 1)
+                        work.append((w, 0))
+                        recurse = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index_of[w])
+                if recurse:
+                    continue
+                work.pop()
+                if low[node] == index_of[node]:
+                    scc: List[str] = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) >= 2:
+                        out.append(sorted(scc))
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+        return sorted(out)
+
+    def _cycle_path(self, scc: List[str]) -> Optional[List[str]]:
+        """Shortest cycle through the lexicographically first node of
+        the SCC — ``[a, ..., a]`` including the closing hop."""
+        start = scc[0]
+        members = set(scc)
+        prev: Dict[str, Optional[str]] = {start: None}
+        frontier = [start]
+        while frontier:
+            nxt: List[str] = []
+            for n in frontier:
+                for m in sorted(self.edges.get(n, {})):
+                    if m == start:
+                        path = [n]
+                        cur = prev[n]
+                        while cur is not None:
+                            path.append(cur)
+                            cur = prev[cur]
+                        path.reverse()
+                        return path + [start]
+                    if m in members and m not in prev:
+                        prev[m] = n
+                        nxt.append(m)
+            frontier = nxt
+        return None
+
+
+def lint_package(index) -> List[Finding]:
+    """CONC301/302/303 over a built package index (plus optional aux
+    seed modules merged by the caller)."""
+    return _Pass(index).run()
+
+
+def lock_graph(index) -> Dict[str, Dict[str, Dict]]:
+    """The raw lock-order graph (``a -> b -> witness``) — for the
+    chaos probe's acyclicity assertion over the live configuration."""
+    p = _Pass(index)
+    p.run()
+    return p.edges

@@ -50,7 +50,7 @@ from deeplearning4j_tpu.analysis.astutil import (FuncDef, FuncIndex,
                                                  add_parents, dotted)
 
 #: bump when the summary schema changes — stale caches self-invalidate
-CACHE_VERSION = 1
+CACHE_VERSION = 2
 
 _MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
                   "deque", "Counter"}
@@ -58,6 +58,38 @@ _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
                "BoundedSemaphore"}
 #: names too generic for the unique-method fallback resolution
 _FALLBACK_MIN_LEN = 4
+#: builtin container/sync/file method names the unique-method fallback
+#: must NEVER resolve: ``in_specs.append(x)`` is a plain list append,
+#: not a call into the one package class that happens to define
+#: ``append`` (the PR-18 false JIT106 edges into TimeSeriesStore came
+#: exactly from this).  Losing a true edge here only shrinks closures
+#: (fewer findings, never new ones), so the list errs broad.
+_FALLBACK_DENY = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "clear", "remove", "discard", "pop", "popleft", "popitem",
+    "setdefault", "sort", "reverse", "copy", "count", "index",
+    "items", "keys", "values", "get", "put", "join", "split",
+    "strip", "format", "encode", "decode", "read", "write", "close",
+    "flush", "acquire", "release", "wait", "notify", "notify_all",
+    "result", "cancel", "is_alive", "is_set", "send", "recv",
+})
+
+#: callback-registration method names: ``table.append(fn)`` /
+#: ``sinks.add(fn)`` / ``bus.register(fn)`` store a callable into a
+#: container another thread may later drain (CONC303 facts)
+_CB_REGISTER = {"append", "add", "insert", "register", "subscribe",
+                "attach", "setdefault", "on", "connect"}
+
+#: how long a constant ``time.sleep`` must be before it counts as a
+#: blocking call (scheduler breathers under 50 ms are noise)
+_SLEEP_THRESHOLD_S = 0.05
+_SUBPROCESS_FNS = {"run", "check_output", "check_call", "call"}
+#: module roots whose EVERY call blocks on the network; urllib/http
+#: are deliberately absent (urllib.parse is pure string work) — their
+#: blocking entry points are caught by method name instead
+_NET_ROOTS = {"socket", "requests"}
+_NET_METHS = {"recv", "recvfrom", "accept", "urlopen", "getresponse",
+              "sendall"}
 
 
 def module_name(relpath: str) -> str:
@@ -79,6 +111,51 @@ def _lockish(parts: Optional[Tuple[str, ...]]) -> bool:
 def _is_ctor_of(call: ast.Call, names: Set[str]) -> bool:
     parts = dotted(call.func)
     return parts is not None and parts[-1] in names
+
+
+def _is_lock_parts(parts: Optional[Tuple[str, ...]],
+                   module_locks: Set[str]) -> bool:
+    """A lock either by NAME convention or by module-level constructor
+    provenance (``_MUTEX = threading.Lock()``)."""
+    return _lockish(parts) or (
+        parts is not None and len(parts) == 1
+        and parts[0] in module_locks)
+
+
+def blocking_call_detail(call: ast.Call) -> Optional[str]:
+    """Why this call can block indefinitely (or long enough to matter
+    under a lock), or None.  Purely syntactic — the lock-order pass
+    (CONC302) decides whether a lock is actually held around it."""
+    parts = dotted(call.func)
+    if parts is None:
+        return None
+    name = parts[-1]
+    nargs = len(call.args)
+    kw = {k.arg for k in call.keywords}
+    timed = "timeout" in kw
+    if name == "sleep" and (len(parts) == 1 or parts[-2] == "time"):
+        if nargs == 1 and isinstance(call.args[0], ast.Constant) and \
+                isinstance(call.args[0].value, (int, float)) and \
+                call.args[0].value < _SLEEP_THRESHOLD_S:
+            return None
+        return "time.sleep(...)"
+    if name == "join" and nargs == 0 and not timed:
+        # "".join(xs) / os.path.join(a, b) always take arguments —
+        # the zero-arg form is a thread/process join
+        return "join() without timeout"
+    if name == "get" and nargs == 0 and not kw:
+        # dict.get() requires a key: the bare form is a queue get
+        return "get() without timeout"
+    if name in ("result", "wait") and nargs == 0 and not timed:
+        return f"{name}() without timeout"
+    if name == "communicate" and not timed:
+        return "communicate() without timeout"
+    if parts[0] == "subprocess" and name in _SUBPROCESS_FNS and \
+            not timed:
+        return f"subprocess.{name}(...)"
+    if parts[0] in _NET_ROOTS or name in _NET_METHS:
+        return f"{'.'.join(parts)}(...) network I/O"
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -118,6 +195,7 @@ class _Extractor:
             "module_state": module_state,
             "module_locks": sorted(module_locks),
             "thread_target_fns": self._module_thread_targets(),
+            "entry_calls": self._entry_calls(),
             "traced_local": traced_local,
         }
 
@@ -242,6 +320,26 @@ class _Extractor:
                     out.append(list(tp))
         return out
 
+    def _entry_calls(self) -> List[List[str]]:
+        """Module-level calls (including under ``if __name__ ==
+        "__main__":``) — what running the file as a script executes
+        with no thread/class context.  Seeds the lock-order pass's
+        thread-reachability for ``scripts/`` entry points."""
+        out: List[List[str]] = []
+        queue: List[ast.AST] = list(self.tree.body)
+        i = 0
+        while i < len(queue):
+            n = queue[i]
+            i += 1
+            if isinstance(n, FuncDef + (ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(n, ast.Call):
+                p = dotted(n.func)
+                if p:
+                    out.append(list(p))
+            queue.extend(ast.iter_child_nodes(n))
+        return out
+
     # -- trace entries (local pass's view) -----------------------------
     def _traced_local(self) -> Dict[str, List[str]]:
         from deeplearning4j_tpu.analysis import jit_lint as _jl
@@ -326,17 +424,13 @@ class _Extractor:
         ``self._lock``, ``server._pool_lock``) or by module-level
         CONSTRUCTOR provenance (``_MUTEX = threading.Lock()`` counts
         even though nothing in the name says so)."""
-        def is_lock(parts) -> bool:
-            return _lockish(parts) or (
-                parts is not None and len(parts) == 1
-                and parts[0] in module_locks)
-
         out: Dict[int, List[Tuple]] = {}
         for n in self._body(fn):
             if not isinstance(n, ast.With):
                 continue
             lock_parts = [dotted(i.context_expr) for i in n.items
-                          if is_lock(dotted(i.context_expr))]
+                          if _is_lock_parts(dotted(i.context_expr),
+                                            module_locks)]
             if not lock_parts:
                 continue
             for stmt in n.body:
@@ -362,6 +456,19 @@ class _Extractor:
         globals_declared: Set[str] = set()
         local_stores: Set[str] = set()
         returns_fns: List[str] = []
+        acquires: List[List] = []
+        blocking: List[List] = []
+        cb_stores: List[List] = []
+        cb_invokes: List[List] = []
+
+        def held_at(node: ast.AST) -> List[List[str]]:
+            """Deduped lock parts lexically held around ``node``."""
+            out: List[List[str]] = []
+            for lp in locked.get(id(node), ()):
+                l = list(lp)
+                if l not in out:
+                    out.append(l)
+            return out
 
         def type_of_base(node: ast.AST) -> Optional[List[str]]:
             p = dotted(node)
@@ -389,13 +496,72 @@ class _Extractor:
                 impure.append([n.lineno, "global",
                                "global " + ", ".join(n.names)])
 
+        # container-drain aliases: ``for cb in self._sinks:`` /
+        # ``cb = self._tbl[k]`` / ``cb = self._tbl.get(k)`` bind a name
+        # whose CALL is an invocation through the container (CONC303)
+        drained: Dict[str, List[str]] = {}
+        for n in self._body(fn):
+            if isinstance(n, ast.For):
+                it = n.iter
+                if isinstance(it, ast.Call) and \
+                        (ip := dotted(it.func)) and \
+                        ip[-1] in ("list", "tuple", "sorted") and it.args:
+                    it = it.args[0]
+                if isinstance(it, ast.Call) and \
+                        isinstance(it.func, ast.Attribute) and \
+                        it.func.attr in ("values", "items") and \
+                        not it.args:
+                    it = it.func.value
+                cont = dotted(it)
+                if not cont:
+                    continue
+                tgt = n.target
+                if isinstance(tgt, ast.Tuple) and len(tgt.elts) == 2:
+                    tgt = tgt.elts[1]       # for key, cb in tbl.items()
+                if isinstance(tgt, ast.Name):
+                    drained[tgt.id] = list(cont)
+            elif isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                v = n.value
+                cont = None
+                if isinstance(v, ast.Subscript):
+                    cont = dotted(v.value)
+                elif isinstance(v, ast.Call) and \
+                        isinstance(v.func, ast.Attribute) and \
+                        v.func.attr == "get":
+                    cont = dotted(v.func.value)
+                if cont:
+                    drained[n.targets[0].id] = list(cont)
+
         for n in self._body(fn):
             if isinstance(n, FuncDef):
                 pass
+            elif isinstance(n, ast.With):
+                # lock-acquisition site: which lock, under which
+                # already-held locks (nested with-regions give the
+                # direct lock-order edges)
+                w_held = held_at(n)
+                for item in n.items:
+                    lp = dotted(item.context_expr)
+                    if not _is_lock_parts(lp, module_locks):
+                        continue
+                    bt = None
+                    if len(lp) >= 2 and \
+                            isinstance(item.context_expr, ast.Attribute):
+                        bt = type_of_base(item.context_expr.value)
+                    acquires.append([n.lineno, list(lp), bt, w_held])
             elif isinstance(n, ast.Call):
                 detail = _jl.host_impure_detail(n)
                 if detail:
                     impure.append([n.lineno, "host_call", detail])
+                held = held_at(n)
+                if isinstance(n.func, ast.Subscript) and \
+                        (sp := dotted(n.func.value)):
+                    cb_invokes.append([n.lineno, list(sp), held])
+                elif isinstance(n.func, ast.Name) and \
+                        n.func.id in drained:
+                    cb_invokes.append([n.lineno,
+                                       drained[n.func.id], held])
                 cp = dotted(n.func)
                 if cp is not None:
                     entry: Dict = {"line": n.lineno}
@@ -410,7 +576,25 @@ class _Extractor:
                         entry["via"] = via[cp[0]]
                     else:
                         entry["parts"] = list(cp)
+                    if held:
+                        entry["locks"] = held
                     calls.append(entry)
+                    bdetail = blocking_call_detail(n)
+                    if bdetail is not None:
+                        blocking.append([n.lineno, bdetail,
+                                         list(cp), held])
+                    if len(cp) >= 2 and cp[-1] in _CB_REGISTER:
+                        # the full call + receiver type ride along so
+                        # the lock-order pass can follow ONE forwarding
+                        # hop (bus.subscribe(cb) appends its param to
+                        # the real table inside Bus.subscribe)
+                        for arg in n.args:
+                            fp = dotted(arg)
+                            if fp:
+                                cb_stores.append([n.lineno,
+                                                  list(cp[:-1]),
+                                                  list(fp), held,
+                                                  list(cp), base_t])
             elif isinstance(n, ast.Return) and n.value is not None:
                 vals = [n.value]
                 if isinstance(n.value, ast.IfExp):
@@ -502,6 +686,17 @@ class _Extractor:
             foreign.append([n.lineno, base_t, n.attr, kind,
                             base_locked(n, n.value)])
 
+        # handler-table registration through subscript assignment:
+        # ``self._handlers[kind] = self._on_kind``
+        for n in self._body(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Subscript):
+                cont = dotted(n.targets[0].value)
+                fp = dotted(n.value)
+                if cont and fp:
+                    cb_stores.append([n.lineno, list(cont), list(fp),
+                                      held_at(n), None, None])
+
         static_ann, traced_ann, ptypes = _ann.param_annotations(fn)
         return {
             "line": fn.lineno,
@@ -517,6 +712,10 @@ class _Extractor:
             "module_writes": module_writes,
             "foreign": foreign,
             "returns_fns": sorted(set(returns_fns)),
+            "acquires": acquires,
+            "blocking": blocking,
+            "cb_stores": cb_stores,
+            "cb_invokes": cb_invokes,
         }
 
 
@@ -541,9 +740,14 @@ class PackageIndex:
     ``meth`` and the name is specific enough), which trace/thread
     closures need for duck-typed callees."""
 
-    def __init__(self, summaries: Dict[str, Dict]):
+    def __init__(self, summaries: Dict[str, Dict],
+                 aux: Iterable[str] = ()):
         #: module name -> summary
         self.modules = summaries
+        #: modules indexed only to SEED reachability (scripts/ entry
+        #: points) — cross-module passes must not report findings in
+        #: them, only follow their edges into the package
+        self.aux_modules: Set[str] = set(aux)
         self.functions: Dict[str, Dict] = {}
         self.func_module: Dict[str, str] = {}
         self._methods_by_name: Dict[str, List[str]] = {}
@@ -564,6 +768,9 @@ class PackageIndex:
     @property
     def n_modules(self) -> int:
         return len(self.modules)
+
+    def is_aux(self, mod: str) -> bool:
+        return mod in self.aux_modules
 
     # -- symbol resolution ---------------------------------------------
     def resolve_import(self, mod: str, name: str
@@ -774,7 +981,8 @@ class PackageIndex:
         if parts[0] in self.modules[mod]["imports"]:
             return []
         meth = parts[-1]
-        if len(meth) >= _FALLBACK_MIN_LEN or meth.startswith("_"):
+        if meth not in _FALLBACK_DENY and \
+                (len(meth) >= _FALLBACK_MIN_LEN or meth.startswith("_")):
             cands = self._methods_by_name.get(meth, [])
             if len(cands) == 1:
                 return [cands[0]]
@@ -849,6 +1057,17 @@ class PackageIndex:
                 # resolve in the module that spawns the thread — a
                 # launcher module with no defs of its own still seeds
                 seeds.extend(self.resolve_in_module(mod, tp))
+        return seeds
+
+    def entry_seeds(self) -> List[str]:
+        """Functions the aux (``scripts/``) modules' module-level code
+        calls — the bare-entry-point reachability the thread closure
+        alone misses (a script's main thread IS a thread context)."""
+        seeds: List[str] = []
+        for mod in sorted(self.aux_modules):
+            s = self.modules.get(mod) or {}
+            for parts in s.get("entry_calls", ()):
+                seeds.extend(self.resolve_in_module(mod, parts))
         return seeds
 
     # -- trace seeds ----------------------------------------------------
@@ -964,6 +1183,7 @@ def build_index(pkg_dir: str, root: Optional[str] = None,
                                           "module_state": {},
                                           "module_locks": [],
                                           "thread_target_fns": [],
+                                          "entry_calls": [],
                                           "traced_local": {}},
                               "findings": [f.to_dict()]}
             summaries[modname] = files_out[rel]["summary"]

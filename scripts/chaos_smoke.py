@@ -1031,6 +1031,12 @@ def main(min_history_s: float = 60.0) -> int:
 
     # -- static analysis: lint series on the wire ----------------------
     ct.emit_analysis_series(problems)
+    # the LIVE configuration's lock-order graph (fleet + ladder +
+    # autoscaler + alert + tsdb threads) must be acyclic — a CONC301
+    # cycle is a latent deadlock and fails the chaos run outright
+    ct.assert_live_lock_order(problems, cache_path=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".dl4j_lint_cache.json"))
 
     # -- every kind fired (preempt thrice: matrix + bit-identical run
     # + pipeline fleet run; every scheduled serve stall throttled a
@@ -1055,6 +1061,7 @@ def main(min_history_s: float = 60.0) -> int:
     required += [f'faults_injected_total{{kind="{k}"}}'
                  for k in resilience.FAULT_KINDS]
     required += ["retry_attempts_bucket", "retry_backoff_seconds_bucket"]
+    required += ["lint_lock_graph_cycles"]
     # the fleet/salvage counters must carry the REAL recovery values on
     # the wire, not just exist
     for needle in ("fleet_preempt_broadcasts_total",

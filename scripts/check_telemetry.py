@@ -326,6 +326,47 @@ def emit_analysis_series(problems) -> None:
     package_index.emit_index_telemetry(stats)
 
 
+def assert_live_lock_order(problems, cache_path=None) -> None:
+    """Build the lock-order graph of the LIVE serving configuration —
+    the fleet scheduler, degrade-ladder clock, autoscaler, alert
+    engine and TSDB recorder threads all live under ``serving/`` +
+    ``telemetry/`` — and assert it is ACYCLIC (ISSUE 19): a CONC301
+    cycle there is a latent production deadlock, so the chaos run
+    fails on it rather than leaving it to the lint gate.  The pass
+    runtime lands in ``lint_runtime_seconds`` and the cycle count on
+    the ``lint_lock_graph_cycles`` gauge so the scrape proves the
+    probe ran."""
+    import time as _time
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.analysis import lock_order, package_index
+    pkgroot = os.path.dirname(os.path.dirname(package_index.__file__))
+    root = os.path.dirname(pkgroot)
+    t0 = _time.perf_counter()
+    merged, stats = {}, package_index.IndexStats()
+    for sub in ("serving", "telemetry"):
+        idx, _, st = package_index.build_index(
+            os.path.join(pkgroot, sub), root=root,
+            cache_path=cache_path, run_local_passes=False)
+        merged.update(idx.modules)
+        stats.modules += st.modules
+        stats.cache_hits += st.cache_hits
+    live = package_index.PackageIndex(merged)
+    cycles = [f for f in lock_order.lint_package(live)
+              if f.rule == "CONC301"]
+    stats.elapsed_s = _time.perf_counter() - t0
+    for f in cycles:
+        problems.append(
+            f"lock-order CYCLE in the live serving config: {f.message}")
+    if stats.modules < 10:
+        problems.append("live lock-order probe indexed only "
+                        f"{stats.modules} modules")
+    telemetry.gauge(
+        "lint_lock_graph_cycles",
+        "CONC301 cycles in the live serving configuration's "
+        "lock-order graph (must be 0)").set(len(cycles))
+    package_index.emit_index_telemetry(stats)
+
+
 def scrape_body(telemetry, registry) -> str:
     """Serve one scrape over a real HTTP endpoint and return the
     Prometheus text body (shared with chaos_smoke)."""

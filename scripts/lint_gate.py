@@ -21,7 +21,17 @@ checked-in ``ANALYSIS_BASELINE.json``, and:
   fast pre-commit loops.  Off-diff new findings are reported as a
   note, not a failure;
 * ``--audit-baseline`` audits the debt ledger: stale keys (fixed debt
-  still listed) and entries with no justification fail the audit.
+  still listed) and entries with no justification fail the audit;
+* ``--prune`` rewrites the baseline dropping stale keys (entries
+  whose finding no longer fires anywhere in the package), preserving
+  the justifications of surviving keys;
+* ``--check`` makes stale keys a FAILURE rather than a note — the CI
+  invocation, so baseline rot cannot accumulate silently.
+
+The ``scripts/`` directory itself is indexed as an AUX seed: its
+module-level entry points root the lock-order pass's
+thread-reachability (CONC301/302/303), but findings are only ever
+reported inside the package.
 
 Wired alongside ``check_telemetry.py`` / ``chaos_smoke.py``:
 
@@ -46,6 +56,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = os.path.join(REPO, "ANALYSIS_BASELINE.json")
 DEFAULT_PATHS = [os.path.join(REPO, "deeplearning4j_tpu")]
 DEFAULT_CACHE = os.path.join(REPO, ".dl4j_lint_cache.json")
+#: aux seed dirs: scripts/ entry points root the lock-order pass's
+#: thread-reachability (no findings are reported in them)
+DEFAULT_SEED_DIRS = [os.path.join(REPO, "scripts")]
 
 
 def changed_files(diff_base: str):
@@ -82,6 +95,18 @@ def main(argv=None) -> int:
     ap.add_argument("--audit-baseline", action="store_true",
                     help="report stale / unjustified baseline keys; "
                          "exit 1 when any exist")
+    ap.add_argument("--prune", action="store_true",
+                    help="rewrite the baseline dropping keys whose "
+                         "finding no longer fires anywhere (fixed "
+                         "debt), preserving surviving justifications")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: ALSO fail (exit 1) when pruneable "
+                         "stale baseline keys exist — baseline rot "
+                         "is a gate failure, not a note")
+    ap.add_argument("--seed-dir", action="append", default=None,
+                    help="aux directory whose entry points seed the "
+                         "lock-order pass (default: scripts/; pass "
+                         "an empty value to disable)")
     ap.add_argument("--changed-only", action="store_true",
                     help="gate only on new findings in files changed "
                          "vs --diff-base (full package still indexed)")
@@ -95,13 +120,15 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     paths = args.paths or DEFAULT_PATHS
+    seed_dirs = DEFAULT_SEED_DIRS if args.seed_dir is None \
+        else [d for d in args.seed_dir if d]
     findings, stats = [], None
     for p in paths:
         if os.path.isdir(p):
             fs, st = lint_package(
                 p, root=REPO,
                 cache_path=None if args.no_cache else args.cache,
-                cross=not args.no_cross)
+                cross=not args.no_cross, seed_dirs=seed_dirs)
             findings.extend(fs)
             stats = _merge_stats(stats, st)
         else:
@@ -136,6 +163,20 @@ def main(argv=None) -> int:
     else:
         baseline = Baseline.load(args.baseline)
     new, baselined, stale = baseline.diff(findings)
+
+    if args.prune:
+        if not stale:
+            print("baseline already tight: nothing to prune "
+                  f"({len(baseline.entries)} key(s))")
+            return 0
+        for k in stale:
+            del baseline.entries[k]
+        baseline.save(args.baseline)
+        for k in stale:
+            print(f"- [pruned] {k}")
+        print(f"pruned {len(stale)} stale key(s); "
+              f"{len(baseline.entries)} remain -> {args.baseline}")
+        return 0
 
     if args.audit_baseline:
         unjustified = sorted(k for k, v in baseline.entries.items()
@@ -179,8 +220,11 @@ def main(argv=None) -> int:
               "justification) add them via --update-baseline")
         return 1
     if stale:
-        print("note: stale keys are fixed debt; prune with "
-              "--update-baseline")
+        if args.check:
+            print("FAIL: stale baseline keys (fixed debt still "
+                  "listed) — prune them with --prune")
+            return 1
+        print("note: stale keys are fixed debt; prune with --prune")
     print("OK")
     return 0
 

@@ -1075,6 +1075,231 @@ class TestCrossModule:
 
 
 # ---------------------------------------------------------------------------
+# lock-order pass: CONC301 / CONC302 / CONC303
+# ---------------------------------------------------------------------------
+
+def _lock_index(tmp_path, modules):
+    from deeplearning4j_tpu.analysis import package_index
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, src in modules.items():
+        (pkg / f"{name}.py").write_text(textwrap.dedent(src))
+    idx, _, _ = package_index.build_index(str(pkg), root=str(tmp_path))
+    return idx
+
+
+class TestLockOrder:
+    def test_conc301_abba_cycle_across_modules(self, tmp_path):
+        from deeplearning4j_tpu.analysis import lock_order
+        idx = _lock_index(tmp_path, {
+            "a": """
+                import threading
+                from pkg.b import Registry
+
+                class Engine:
+                    def __init__(self, reg: Registry):
+                        self._lock = threading.Lock()
+                        self._reg = reg
+
+                    def pump(self):
+                        with self._lock:
+                            self._reg.publish(1)
+
+                    def grab(self):
+                        with self._lock:
+                            return 1
+            """,
+            "b": """
+                import threading
+
+                class Registry:
+                    def __init__(self):
+                        self._reg_lock = threading.Lock()
+
+                    def wire(self, engine: "Engine"):
+                        self.engine = engine
+
+                    def publish(self, v):
+                        with self._reg_lock:
+                            self._val = v
+
+                    def poke(self):
+                        with self._reg_lock:
+                            self.engine.grab()
+            """})
+        (c,) = [f for f in lock_order.lint_package(idx)
+                if f.rule == "CONC301"]
+        assert c.severity == "error"
+        # both witness paths, one per direction, with the via chains
+        assert "Engine._lock" in c.message
+        assert "Registry._reg_lock" in c.message
+        assert "Registry.publish" in c.message   # pump -> publish
+        assert "Engine.grab" in c.message        # poke -> grab
+
+    def test_conc302_blocking_under_lock(self, tmp_path):
+        from deeplearning4j_tpu.analysis import lock_order
+        idx = _lock_index(tmp_path, {"w": """
+            import queue
+            import threading
+            import time
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def bad_join(self, t):
+                    with self._lock:
+                        t.join()
+
+                def bad_sleep(self):
+                    with self._lock:
+                        time.sleep(0.5)
+
+                def ok_bounded_get(self):
+                    with self._lock:
+                        return self._q.get(timeout=0.1)
+
+                def ok_short_sleep(self):
+                    with self._lock:
+                        time.sleep(0.001)
+
+                def ok_outside(self, t):
+                    t.join()
+        """})
+        fs = [f for f in lock_order.lint_package(idx)
+              if f.rule == "CONC302"]
+        assert {f.symbol for f in fs} == {"Worker.bad_join",
+                                          "Worker.bad_sleep"}
+        assert all(f.severity == "warning" and "_lock" in f.message
+                   for f in fs)
+
+    def test_conc303_callback_reacquires_held_lock(self, tmp_path):
+        from deeplearning4j_tpu.analysis import lock_order
+        idx = _lock_index(tmp_path, {"bus": """
+            import threading
+
+            class Bus:
+                def __init__(self):
+                    self._bus_lock = threading.Lock()
+                    self._sinks = []
+                    self._t = threading.Thread(target=self.drain)
+
+                def subscribe(self, fn):
+                    self._sinks.append(fn)
+
+                def drain(self):
+                    with self._bus_lock:
+                        for cb in self._sinks:
+                            cb()
+
+            class Flusher:
+                def __init__(self, bus: Bus):
+                    self._bus = bus
+                    bus.subscribe(self.flush)
+
+                def flush(self):
+                    with self._bus._bus_lock:
+                        pass
+
+            class Logger:
+                def __init__(self, bus: Bus):
+                    self._log_lock = threading.Lock()
+                    bus.subscribe(self.emit)
+
+                def emit(self):
+                    with self._log_lock:
+                        pass
+        """})
+        (f,) = [f for f in lock_order.lint_package(idx)
+                if f.rule == "CONC303"]
+        assert f.severity == "error" and f.symbol == "Bus.drain"
+        assert "Flusher.flush" in f.message and "_bus_lock" in f.message
+        # Logger.emit takes a DIFFERENT lock: no re-acquisition, so no
+        # finding — but its acquisition must still join the graph
+        g = lock_order.lock_graph(idx)
+        assert any(b.endswith("Logger._log_lock") for b in
+                   g.get("pkg.bus::Bus._bus_lock", ()))
+
+    def test_consistent_order_and_same_context_cb_are_clean(
+            self, tmp_path):
+        from deeplearning4j_tpu.analysis import lock_order
+        idx = _lock_index(tmp_path, {"m": """
+            import threading
+
+            class A:
+                def __init__(self, b: "B"):
+                    self._a_lock = threading.Lock()
+                    self._b = b
+
+                def one(self):
+                    with self._a_lock:
+                        self._b.step()
+
+                def two(self):
+                    with self._a_lock:
+                        self._b.step()
+
+            class B:
+                def __init__(self):
+                    self._b_lock = threading.Lock()
+                    self._sinks = []
+                    self._t = threading.Thread(target=self.drain)
+
+                def step(self):
+                    with self._b_lock:
+                        pass
+
+                def wire(self, client: "Client"):
+                    with self._b_lock:
+                        self._sinks.append(client.on_evt)
+
+                def drain(self):
+                    with self._b_lock:
+                        for cb in self._sinks:
+                            cb()
+
+            class Client:
+                def __init__(self, b: B):
+                    self._owner = b
+
+                def on_evt(self):
+                    with self._owner._b_lock:
+                        pass
+        """})
+        # a -> b twice is consistent (no CONC301); the callback is
+        # registered under the SAME lock the drain holds, so the lock
+        # context matches and CONC303 stays quiet
+        assert lock_order.lint_package(idx) == []
+
+    def test_live_serving_lock_graph_pinned_acyclic(self):
+        # regression pin for the fleet-lock / ladder-lock boundary:
+        # ServingFleet.submit snapshots under the fleet lock and shapes
+        # admission OUTSIDE it, so the live serving + telemetry graph
+        # is acyclic with the fleet lock strictly upstream of the
+        # alert-engine lock
+        from deeplearning4j_tpu.analysis import lock_order, package_index
+        pkgroot = os.path.join(REPO, "deeplearning4j_tpu")
+        merged = {}
+        for sub in ("serving", "telemetry"):
+            idx, _, _ = package_index.build_index(
+                os.path.join(pkgroot, sub), root=REPO,
+                run_local_passes=False)
+            merged.update(idx.modules)
+        live = package_index.PackageIndex(merged)
+        assert [f for f in lock_order.lint_package(live)
+                if f.rule == "CONC301"] == []
+        g = lock_order.lock_graph(live)
+        (fleet,) = [a for a in g if a.endswith("ServingFleet._lock")]
+        assert any(b.endswith("AlertEngine._lock") for b in g[fleet])
+        for a, bs in g.items():
+            if a.endswith("AlertEngine._lock"):
+                assert not any(b.endswith("ServingFleet._lock")
+                               for b in bs)
+
+
+# ---------------------------------------------------------------------------
 # gate subcommands: --changed-only, --audit-baseline
 # ---------------------------------------------------------------------------
 
