@@ -342,6 +342,21 @@ def _filter_logits_rows(logits, top_k, top_p):
     return jnp.where(logits < thresh, -jnp.inf, logits)
 
 
+def _filtered_logprobs_rows(logits, temp, top_k, top_p):
+    """Log-probabilities of each row's ACTUAL sampling distribution:
+    temperature-scale (rows with temp <= 0 are greedy — scaled by 1 so
+    the row stays finite; callers mask them out), top-k/top-p filter
+    via ``_filter_logits_rows``, then log-softmax (-inf survives for
+    filtered-out tokens).  This is the density the speculative
+    accept/residual math needs on BOTH sides of the rejection test —
+    the draft's proposal distribution and the target's verify
+    distribution must be the post-filter ones, or the committed stream
+    drifts from what direct sampling would produce."""
+    safe = jnp.where(temp > 0.0, temp, 1.0)
+    lg = _filter_logits_rows(logits / safe[:, None], top_k, top_p)
+    return jax.nn.log_softmax(lg, axis=-1)
+
+
 def _filter_logits(logits, top_k, top_p):
     """Nucleus/top-k filtering on [b, V] logits (already
     temperature-scaled): outside-the-set entries go to -inf."""

@@ -123,12 +123,20 @@ acceptance pattern (the verification runs flat-row matmuls and
 per-row-unrolled attention precisely so its logits and cache writes
 are bitwise equal to sequential ticks).  Up to ``rounds`` such rounds
 fuse into one dispatch, staged in the same [B, R*W] buffer /
-``emitted``-counter machinery the multi-tick scan uses.  Sampled
-slots fall the pool back to the plain scan (greedy acceptance has no
-rejection-sampling form here); draft staleness from fallback ticks
-costs acceptance rate, never parity.  ``generation_server_spec_
-{proposed,accepted}_total`` + the acceptance-rate gauge watch the
-draft's quality in production.
+``emitted``-counter machinery the multi-tick scan uses.  SAMPLED
+slots speculate too (ISSUE 20): proposals are drawn from the draft's
+per-slot-filtered distribution and accepted by Leviathan rejection
+resampling (``u < p_target/p_draft``), a genuine rejection holding
+the normalized residual ``max(0, p - q)`` as the slot's next-anchor
+distribution — the committed stream is EXACTLY target-distributed,
+and greedy rows in the same mixed pool keep the byte-identical greedy
+rule.  With ``adaptive: True`` an :class:`AcceptanceController` tunes
+each slot's draft depth within ``[1, k_max]`` from per-(tenant,
+prefix) acceptance EWMAs (TSDB-seeded via :meth:`attach_history`),
+dispatched through a per-slot ``kcap`` operand so depth changes never
+recompile.  ``generation_server_spec_{proposed,accepted}_total``, the
+acceptance-rate + adaptive-K gauges and the per-tenant acceptance
+series watch the draft's quality in production.
 
 TIERED KV cache (``host_tier_blocks``, PR 14): HBM is the binding
 serving constraint, and an LRU-evicted prefix block used to die —
@@ -185,7 +193,8 @@ from deeplearning4j_tpu.analysis import sanitize as _sanitize
 #: black-box ring a postmortem bundle freezes
 _FLIGHT = telemetry.get_flight_recorder()
 from deeplearning4j_tpu.models.generation import (TransformerGenerator,
-                                                  _filter_logits_rows)
+                                                  _filter_logits_rows,
+                                                  _filtered_logprobs_rows)
 from deeplearning4j_tpu.parallel import speculative as _speculative
 from deeplearning4j_tpu.parallel.kv_tiering import HostKVTier
 from deeplearning4j_tpu.parallel.mesh import TpShardCtx, serving_mesh
@@ -369,6 +378,18 @@ _SPEC_ACCEPT_RATE = telemetry.gauge(
     "generation_server_spec_acceptance_rate",
     "cumulative accepted/proposed draft-token ratio of the most "
     "recently dispatching speculative server")
+_SPEC_ADAPTIVE_K = telemetry.gauge(
+    "generation_server_spec_adaptive_k",
+    "draft depth K of the most recent speculative dispatch — the "
+    "acceptance controller's pick (max over live slots) clamped by "
+    "the degrade ladder's shrink_draft_k cap; a fixed-K server "
+    "reports its configured k")
+_TENANT_SPEC_ACCEPT = telemetry.gauge(
+    "generation_server_tenant_spec_acceptance_rate",
+    "cumulative per-tenant accepted/proposed draft-token ratio (the "
+    "acceptance controller's raw signal: a tenant whose prompts the "
+    "draft models poorly converges to a shallower adaptive K than "
+    "its neighbors)", labelnames=("tenant",))
 # Mesh-sharded serving (ISSUE 17): the tp degree of the most recently
 # constructed server — 1 means single-device; N means params + KV
 # heads spread over an N-chip slice (the per-replica split lives in
@@ -421,11 +442,15 @@ def _pow2_floor(n: int) -> int:
 # host-tier entries to restore, ``(k, v)`` numpy pairs aligned with
 # hash indices ``[reg_from, reg_from + len(fills))`` — their target
 # pool blocks are the first ``len(fills)`` fresh claims, so ``phys``
-# stays in table order.
+# stays in table order.  ``dmatched`` — how many leading ``dphys``
+# entries are DRAFT prefix-cache hits (ISSUE 20: draft blocks
+# chain-hash and re-use exactly like target blocks, in their own hash
+# domain — the hit-path admission gathers them and draft-prefills
+# only the suffix instead of re-paying the full prompt).
 _AdmitPlan = namedtuple("_AdmitPlan", ("phys", "matched", "hashes",
                                        "n_fresh", "dphys", "reg_from",
-                                       "fills"),
-                        defaults=((), 0, ()))
+                                       "fills", "dmatched"),
+                        defaults=((), 0, (), 0))
 
 
 def _kill_slots(state, mask):
@@ -445,15 +470,21 @@ class _Pending:
     __slots__ = ("prompt", "n_new", "eos_id", "seed", "temperature",
                  "top_k", "top_p", "t_submit", "deadline", "cancelled",
                  "t0", "emitted", "ttft", "trace_id", "spans",
-                 "prefill_only", "_t_decode", "_result", "_error",
-                 "_event")
+                 "prefill_only", "tenant", "pkey", "_t_decode",
+                 "_result", "_error", "_event")
 
     def __init__(self, prompt, n_new, eos_id, seed,
                  temperature: float = 0.0, top_k: int = 1,
                  top_p: float = 1.0,
                  deadline: Optional[float] = None,
                  trace_id: Optional[str] = None,
-                 prefill_only: bool = False):
+                 prefill_only: bool = False,
+                 tenant: str = "default",
+                 pkey=None):
+        self.tenant = str(tenant)     # acceptance-controller + gauge key
+        self.pkey = pkey              # leading-block chain hash (or
+                                      # None) — the per-prefix half of
+                                      # the controller's (tenant, pkey)
         self.trace_id = trace_id      # fleet-minted; None standalone
         self.spans = {}               # phase -> open telemetry.Span
         self.prefill_only = bool(prefill_only)  # disagg: admit +
@@ -769,6 +800,24 @@ class GenerationServer:
         # router ranks replicas on THEIR acceptance, not the process's)
         self._n_spec_proposed = 0
         self._n_spec_accepted = 0
+        # per-tenant acceptance tallies feeding the labeled gauge (the
+        # controller's raw signal, aggregated for the scrape)
+        self._tenant_spec = {}
+        # degrade-ladder cap on the draft depth (shrink_draft_k rung):
+        # None = uncapped; clamps BOTH the adaptive controller's k_max
+        # and a fixed-K server's dispatch depth, reversibly
+        self._draft_k_cap = None
+        # acceptance-adaptive K (ISSUE 20): every speculative server
+        # carries the controller — it observes acceptance per (tenant,
+        # leading-prefix) key regardless, and drives the dispatch
+        # depth when the config says adaptive (attach_history() seeds
+        # a cold controller from the TSDB counter history)
+        self._spec_ctl = None
+        if self._spec is not None:
+            self._spec_ctl = _speculative.AcceptanceController(
+                self._spec.k_max,
+                draft_cost=(self._spec.draft.n_layers
+                            / len(gen.blocks)))
         self._stop_event = threading.Event()   # ends the watchdog
         # retire prior DEAD servers' series before adding ours: the
         # last-known 0 stays scrapeable until the next construction,
@@ -825,6 +874,15 @@ class GenerationServer:
             "temp": jnp.zeros((B,), jnp.float32),
             "tk": jnp.full((B,), self._vocab, jnp.int32),
             "tp": jnp.ones((B,), jnp.float32),
+            # True while the slot's held "logits" are a RAW sampling
+            # distribution (the speculative rejection residual, in
+            # log-weights): the next token draw must sample it
+            # directly — re-applying temperature/top-k/top-p would
+            # double-filter and break the rejection-sampling guarantee
+            # (ISSUE 20).  Both the plain scan and the spec rounds
+            # consume + clear it, so a mid-request spec→plain fallback
+            # stays exactly target-distributed.
+            "rawlg": jnp.zeros((B,), jnp.bool_),
             # per-slot block table: logical block j of the slot lives
             # in pool block table[slot, j]; 0 = unallocated (scratch)
             "table": jnp.zeros((B, self.max_blocks), jnp.int32),
@@ -852,6 +910,16 @@ class GenerationServer:
             self._block_hash = {}        # pool block id -> chain hash
             self._evictable = OrderedDict()   # cached ref-0 blocks, LRU
             self._slot_blocks = {}       # slot -> [pool block ids]
+            # DRAFT prefix cache (ISSUE 20): same chain hashes, its
+            # own hash->block map — a block holds either target KV
+            # (all layers) or draft KV (the first draft_layers only),
+            # so the two domains can never share a physical block.
+            # _draft_cached marks which _block_hash entries belong to
+            # the draft domain (eviction/recovery must pop the right
+            # map, and draft blocks never spill to the host tier —
+            # the tier stores target-domain bytes only).
+            self._dprefix_map = {}       # chain hash -> (blk, tok)
+            self._draft_cached = set()   # draft-domain pool block ids
         _POOL_FREE.set(self.kv_blocks)
         _POOL_EVICTABLE.set(0)
 
@@ -985,6 +1053,10 @@ class GenerationServer:
                 # draft tables), and the acceptance rate is the
                 # replica's effective tokens-per-verify multiplier
                 "spec_k": (self._spec.k if self._spec else 0),
+                "spec_adaptive": bool(self._spec.adaptive
+                                      if self._spec else False),
+                "spec_k_max": (self._spec.k_max if self._spec else 0),
+                "spec_k_cap": self._draft_k_cap,
                 "spec_proposed": self._n_spec_proposed,
                 "spec_accepted": self._n_spec_accepted,
                 "spec_acceptance_rate": (
@@ -1138,14 +1210,37 @@ class GenerationServer:
 
     def set_spec_enabled(self, enabled: bool) -> None:
         """Suspend (False) or resume (True) speculative decoding on a
-        live server — rung 3 of the fleet's degradation ladder
-        (ISSUE 18).  Suspension skips draft+verify rounds entirely
-        from the next tick on; the draft state stays resident, so
-        resuming costs nothing but the stale-draft-KV acceptance dip
-        the greedy fallback already tolerates.  A no-op on a server
-        built without ``speculative=``."""
+        live server — the ``spec_off`` rung of the fleet's degradation
+        ladder (ISSUE 18).  Suspension skips draft+verify rounds
+        entirely from the next tick on; the draft state stays
+        resident, so resuming costs nothing but the stale-draft-KV
+        acceptance dip the fallback already tolerates.  A no-op on a
+        server built without ``speculative=``."""
         with self._lock:
             self._spec_off = not bool(enabled)
+
+    def set_draft_k_cap(self, cap: Optional[int]) -> None:
+        """Cap the speculative draft depth on a live server — the
+        ``shrink_draft_k`` rung of the degradation ladder (ISSUE 20),
+        one rung gentler than ``spec_off``: speculation keeps running
+        (and keeps its tokens-per-verify win) but both the adaptive
+        controller's ``k_max`` and a fixed-K server's dispatch depth
+        clamp to ``cap`` from the next dispatch on, shrinking the
+        draft compute and the rejected-work tail under pressure.
+        ``None`` lifts the cap (the rung's reversible exit).  A no-op
+        on a non-speculative server."""
+        with self._lock:
+            self._draft_k_cap = (None if cap is None
+                                 else max(1, int(cap)))
+
+    def attach_history(self, store) -> None:
+        """Attach a :class:`~..telemetry.tsdb.TimeSeriesStore` so the
+        acceptance controller can seed a cold start from the beaconed
+        ``generation_server_spec_{proposed,accepted}_total`` history
+        (PR 16 recorder) instead of guessing ``k_max`` until its own
+        EWMA warms.  A no-op on a non-speculative server."""
+        if self._spec_ctl is not None:
+            self._spec_ctl.attach_store(store)
 
     def demote_waiting(self, n_new_factor: Optional[float] = None,
                        force_greedy: bool = False) -> int:
@@ -1244,6 +1339,15 @@ class GenerationServer:
         relies on)."""
         blk, _ = self._evictable.popitem(last=False)        # LRU out
         hsh = self._block_hash.pop(blk)
+        if blk in self._draft_cached:
+            # draft-domain entry: its own map, and NEVER tier-spilled
+            # — the tier holds target-domain bytes (a draft block is
+            # d cheap layers of re-derivable KV; respilling it would
+            # displace target blocks worth n expensive layers each)
+            self._draft_cached.discard(blk)
+            self._dprefix_map.pop(hsh, None)
+            self._blocks_free.append(blk)
+            return
         _, tok = self._prefix_map.pop(hsh)
         # spilling is the CONFIGURED knob (host_tier_blocks > 0), not
         # tier existence: a lazily-created handoff tier on an
@@ -1305,19 +1409,32 @@ class GenerationServer:
                     break
                 fills.append(entry)
         # speculative decode: the DRAFT's KV table needs the same
-        # block count, always fresh (draft rows are proposal-history-
-        # dependent, never prefix-shareable) — claimed from the SAME
-        # free list, so draft KV competes in the same economy.  A
-        # prefill-ONLY request never decodes, so it claims no draft
+        # block count — claimed from the SAME free list, so draft KV
+        # competes in the same economy.  Full prompt draft blocks are
+        # prefix-shareable exactly like target blocks (prefill-derived,
+        # never written after — draft decode writes at pos >= t0), so
+        # the chain walks the DRAFT hash domain too (ISSUE 20); the
+        # walk only runs when the target side hit, which keeps the
+        # draft reuse on the hit-path admit program (the common case —
+        # both domains register together, so their residency tracks).
+        # A prefill-ONLY request never decodes, so it claims no draft
         # table and skips the draft prefill entirely (a speculative
         # prefill replica would otherwise pin ~2x blocks per staged
         # request for KV that is discarded at retire)
-        dneed = (total if self._spec is not None
-                 and not req.prefill_only else 0)
+        use_draft = self._spec is not None and not req.prefill_only
+        dmatched_ids = []
+        if use_draft and (dev_matched or fills):
+            for hsh, tok in hashes:
+                entry = self._dprefix_map.get(hsh)
+                if entry is None or entry[1] != tok:
+                    break
+                dmatched_ids.append(entry[0])
+        dmatched = len(dmatched_ids)
+        dneed = (total - dmatched) if use_draft else 0
         need = total - dev_matched + dneed
         # matched hits sitting in the evictable LRU are about to be
         # CLAIMED, not evicted — they don't count as reclaimable
-        ev_matched = sum(1 for blk in matched_ids
+        ev_matched = sum(1 for blk in matched_ids + dmatched_ids
                          if self._block_ref[blk] == 0
                          and blk in self._evictable)
         if need > (len(self._blocks_free) + len(self._evictable)
@@ -1325,7 +1442,7 @@ class GenerationServer:
             return None
         # claim the hits FIRST: a hit sitting in the evictable LRU must
         # leave it before the eviction loop below could reclaim it
-        for blk in matched_ids:
+        for blk in matched_ids + dmatched_ids:
             if self._block_ref[blk] == 0:
                 self._evictable.pop(blk, None)
             self._block_ref[blk] += 1
@@ -1334,7 +1451,8 @@ class GenerationServer:
         fresh = [self._blocks_free.pop() for _ in range(need)]
         for blk in fresh:
             self._block_ref[blk] = 1
-        dphys = fresh[need - dneed:] if dneed else []
+        dphys = (dmatched_ids + fresh[need - dneed:]
+                 if use_draft else [])
         fresh = fresh[:need - dneed]
         # table order: device hits, then the tier-restore targets (the
         # FIRST len(fills) fresh claims — aligned with hash indices
@@ -1342,8 +1460,9 @@ class GenerationServer:
         # fresh blocks
         return _AdmitPlan(matched_ids + fresh,
                           dev_matched + len(fills), hashes,
-                          len(fresh) + len(dphys), dphys,
-                          reg_from=dev_matched, fills=tuple(fills))
+                          len(fresh) + len(dphys) - dmatched, dphys,
+                          reg_from=dev_matched, fills=tuple(fills),
+                          dmatched=dmatched)
 
     def _register_prefix_locked(self, plan: _AdmitPlan):
         """After the prefill COMMITS, publish the request's new full
@@ -1360,6 +1479,23 @@ class GenerationServer:
             blk = plan.phys[j]
             self._prefix_map[hsh] = (blk, tok)
             self._block_hash[blk] = hsh
+
+    def _register_draft_prefix_locked(self, plan: _AdmitPlan):
+        """Publish the DRAFT's full prompt blocks under the same chain
+        hashes, in the draft-domain map (ISSUE 20).  Draft full prompt
+        blocks are write-free after prefill for the same reason target
+        ones are — draft decode writes at pos >= t0 — so a later
+        same-prefix admission gathers them instead of re-prefilling
+        the draft over the whole prompt."""
+        for j in range(plan.dmatched,
+                       min(len(plan.hashes), len(plan.dphys))):
+            hsh, tok = plan.hashes[j]
+            if hsh in self._dprefix_map:
+                continue                 # coincident entry stands
+            blk = plan.dphys[j]
+            self._dprefix_map[hsh] = (blk, tok)
+            self._block_hash[blk] = hsh
+            self._draft_cached.add(blk)
 
     def _release_slot_blocks_locked(self, slot: int) -> int:
         """Decref a retiring slot's blocks; refcount-0 blocks return
@@ -1397,7 +1533,8 @@ class GenerationServer:
                      seed: int = 0,
                      deadline_s: Optional[float] = None,
                      sampling: Optional[dict] = None,
-                     trace_id: Optional[str] = None) -> _Pending:
+                     trace_id: Optional[str] = None,
+                     tenant: str = "default") -> _Pending:
         """Enqueue one sequence; returns a handle whose ``result()``
         blocks.  ``prompt_ids`` is a 1-D int array; the request decodes
         until ``n_new`` tokens are emitted or ``eos_id`` is sampled.
@@ -1434,10 +1571,17 @@ class GenerationServer:
                     if deadline_s is not None else None)
         temp, tk_eff, tp_eff, seed = self._resolve_sampling(sampling,
                                                             seed)
+        # prefix key for the acceptance controller: the FIRST chain
+        # hash — same prompt family, same key — so acceptance stats
+        # pool per (tenant, prompt-prefix) workload, not per request
+        bs = self.block_size
+        pkey = (hash((0, prompt[:bs].tobytes()))
+                if len(prompt) - 1 >= bs else None)
         req = _Pending(prompt, n_new,
                        -1 if eos_id is None else int(eos_id), seed,
                        temperature=temp, top_k=tk_eff, top_p=tp_eff,
-                       deadline=deadline, trace_id=trace_id)
+                       deadline=deadline, trace_id=trace_id,
+                       tenant=tenant, pkey=pkey)
         return self._enqueue(req)
 
     def prefill_async(self, prompt_ids,
@@ -1518,7 +1662,8 @@ class GenerationServer:
                timeout: Optional[float] = None,
                deadline_s: Optional[float] = None,
                sampling: Optional[dict] = None,
-               retries: Optional[int] = None) -> np.ndarray:
+               retries: Optional[int] = None,
+               tenant: str = "default") -> np.ndarray:
         """Blocking ``submit_async().result()``.  ``retries`` (default:
         the server's ``submit_retries``) re-submits after a
         ``RetryableServerError`` — a watchdog/tick-failure recovery
@@ -1530,7 +1675,8 @@ class GenerationServer:
         def attempt():
             return self.submit_async(prompt_ids, n_new, eos_id, seed,
                                      deadline_s=deadline_s,
-                                     sampling=sampling).result(timeout)
+                                     sampling=sampling,
+                                     tenant=tenant).result(timeout)
 
         if retries <= 0:
             return attempt()
@@ -1610,8 +1756,15 @@ class GenerationServer:
             safe = jnp.where(temp > 0, temp, 1.0)[:, None]
             lg = _filter_logits_rows(state["logits"] / safe,
                                      state["tk"], state["tp"])
+            # rawlg rows hold a residual log-distribution left by a
+            # rejected speculative round (ISSUE 20) — already
+            # temperature/filter-shaped; sample it AS-IS (re-applying
+            # the filters would skew the rejection-sampling residual
+            # and break distribution exactness)
+            lg = jnp.where(state["rawlg"][:, None],
+                           state["logits"], lg)
             cand = jax.vmap(jax.random.categorical)(subs, lg)
-            tok = jnp.where(temp > 0, cand,
+            tok = jnp.where((temp > 0) | state["rawlg"], cand,
                             jnp.argmax(state["logits"], axis=-1))
             return tok, keys
 
@@ -1685,6 +1838,11 @@ class GenerationServer:
                     # leave the draft's KV stale, which costs
                     # acceptance on later rounds, never correctness
                     "dtable": state["dtable"],
+                    # a residual row is consumed by its FIRST sampled
+                    # pick; the greedy program never sees one live
+                    # (residuals only arise on sampled slots)
+                    "rawlg": ((state["rawlg"] & ~active)
+                              if sampled else state["rawlg"]),
                 }
                 emitted = emitted + active.astype(jnp.int32)
                 return (kc, vc, state, emitted), tok
@@ -1818,6 +1976,10 @@ class GenerationServer:
                     "tp": state["tp"],
                     "table": tbl,
                     "dtable": dtbl,
+                    # greedy-only program: no residual can be live in
+                    # this dispatch (the sampled-capable variant is
+                    # _spec_fn2) — pure passthrough
+                    "rawlg": state["rawlg"],
                 }
                 # -- stage the commits at each slot's cursor (the
                 # [B, K]-buffer idiom from PR 5, cursor-scattered;
@@ -1849,6 +2011,236 @@ class GenerationServer:
                 jax.lax.scan(round_body,
                              (kc, vc, state, staged0, emitted0,
                               jnp.int32(0), jnp.int32(0)),
+                             None, length=R)
+            n_alive = jnp.sum((state["remaining"] > 0)
+                              .astype(jnp.int32))
+            return (kc, vc, state, staged[:, :R * W], emitted,
+                    n_alive, prop, acc)
+
+        fn = self._scan_cache[key] = jax.jit(spec_fn,
+                                             donate_argnums=(6, 7, 8))
+        return fn
+
+    def _spec_fn2(self, R: int, K: int, sampled: bool):
+        """The kcap-aware speculative program (ISSUE 20): R rounds at
+        dispatch depth ``K`` (the pool max of the per-slot adaptive
+        depths) with a per-slot ``kcap`` [B] operand masking each
+        slot's proposals down to ITS depth, and — with
+        ``sampled=True`` — Leviathan rejection resampling for
+        temperature>0 rows riding the same flat-row verify:
+
+        * the anchor of a sampled row is drawn from the slot's held
+          distribution (its own temperature/top-k/top-p shaping, or
+          the RAW residual when ``rawlg`` marks one held),
+        * draft proposals are drawn from the DRAFT's identically
+          filtered distribution (the rule requires q, the draft's
+          actual sampling distribution — argmax proposals would make
+          ``p/q`` ill-defined),
+        * proposal i commits iff ``u_i < p_target(x_i)/p_draft(x_i)``
+          and every earlier proposal committed
+          (:func:`speculative.accept_mixed`; greedy rows run the
+          UNCHANGED greedy rule through the same call, which is what
+          keeps them byte-identical to non-spec decode in a mixed
+          pool),
+        * a genuine rejection holds the normalized residual
+          ``max(0, p - q)`` as the slot's next-anchor distribution
+          (``rawlg`` set; consumed by the next round's anchor or, on
+          fallback to the plain scan, by ``pick_sampled``).
+
+        Per-round PRNG: each active slot's stream splits ONCE, and
+        every consumer (anchor, draft step j, acceptance uniforms)
+        folds a fixed tag into the round key — so a slot's token
+        sequence depends only on its seed and its own acceptance
+        history, invariant to R batching and pool composition.
+
+        Returns the legacy tuple with ``proposed`` / ``accepted`` as
+        [B] PER-SLOT vectors (the host attributes them to tenants and
+        feeds the acceptance controller)."""
+        key = ("spec", int(R), int(K), bool(sampled))
+        fn = self._scan_cache.get(key)
+        if fn is not None:
+            return fn
+        gen = self._gen
+        spec = self._spec
+        dgen = spec.draft.gen
+        d = spec.draft.n_layers
+        W = K + 1
+        bs = self.block_size
+        B = self.n_slots
+        shard = self._shard
+
+        def fold_rows(keys, tag):
+            return jax.vmap(jax.random.fold_in,
+                            in_axes=(0, None))(keys, tag)
+
+        def spec_fn(emb_p, blk_stack, head_p, demb_p, dblk, dhead_p,
+                    kc, vc, state, kcap):
+            dblk = jax.tree_util.tree_map(lambda a: a[:d], dblk)
+            jidx = jnp.arange(W)[None, :]
+
+            def round_body(carry, _):
+                kc, vc, state, staged, emitted, prop, acc = carry
+                active = state["remaining"] > 0
+                pos, rem = state["pos"], state["remaining"]
+                tbl, dtbl = state["table"], state["dtable"]
+                temp, tk, tp = state["temp"], state["tk"], state["tp"]
+                greedy_row = temp <= 0.0
+                g_anchor = jnp.argmax(state["logits"], axis=-1)
+                if sampled:
+                    both = jax.vmap(jax.random.split)(state["key"])
+                    newk = jnp.where(active[:, None], both[:, 0],
+                                     state["key"])
+                    rkey = both[:, 1]
+                    safe = jnp.where(temp > 0.0, temp, 1.0)
+                    tflt = _filter_logits_rows(
+                        state["logits"] / safe[:, None], tk, tp)
+                    # a held residual is ALREADY the distribution to
+                    # draw from — re-shaping it would break exactness
+                    alg = jnp.where(state["rawlg"][:, None],
+                                    state["logits"], tflt)
+                    cand = jax.vmap(jax.random.categorical)(
+                        fold_rows(rkey, 0), alg)
+                    anchor = jnp.where(greedy_row, g_anchor, cand)
+                else:
+                    newk, rkey = state["key"], state["key"]
+                    anchor = g_anchor
+                anchor = jnp.where(active, anchor, 0).astype(jnp.int32)
+
+                # -- draft: K proposals through the draft table; same
+                # W = K+1 consume-step discipline as _spec_fn (the
+                # last step's proposal is discarded but its WRITE
+                # keeps the draft context hole-free)
+                kcd, vcd = kc[:d], vc[:d]
+
+                def dstep(c, j):
+                    kcd, vcd, tok = c
+                    ok = active & (j < rem)
+                    p = jnp.where(ok, pos + j, 0)
+                    bidx = jnp.take_along_axis(
+                        dtbl, (p // bs)[:, None], axis=1)[:, 0]
+                    wblk = jnp.where(ok, bidx, 0)
+                    woff = jnp.where(ok, p % bs, 0)
+                    lg, kcd, vcd = dgen._step_paged(
+                        demb_p, dblk, dhead_p, kcd, vcd, tok, p,
+                        dtbl, wblk, woff, shard=shard)
+                    if sampled:
+                        dlp = _filtered_logprobs_rows(lg, temp, tk, tp)
+                        dcand = jax.vmap(jax.random.categorical)(
+                            fold_rows(rkey, j + 1), dlp)
+                        nxt = jnp.where(greedy_row,
+                                        jnp.argmax(lg, axis=-1), dcand)
+                    else:
+                        dlp = jnp.zeros((), jnp.float32)
+                        nxt = jnp.argmax(lg, axis=-1)
+                    nxt = jnp.where(ok, nxt, 0).astype(jnp.int32)
+                    return (kcd, vcd, nxt), (tok, dlp)
+
+                (kcd, vcd, _), (consumed, dlps) = jax.lax.scan(
+                    dstep, (kcd, vcd, anchor), jnp.arange(W))
+                kc = kc.at[:d].set(kcd)
+                vc = vc.at[:d].set(vcd)
+                v = consumed.T                            # [B, W]
+
+                # -- verify: one batched W-token target pass (the
+                # flat-row path greedy parity rides on)
+                okv = active[:, None] & (jidx < rem[:, None])
+                p = pos[:, None] + jidx
+                epos = jnp.where(okv, p, 0)
+                vtok = jnp.where(okv, v, 0)
+                bidx = jnp.take_along_axis(
+                    tbl, jnp.where(okv, p // bs, 0), axis=1)
+                wblk = jnp.where(okv, bidx, 0)
+                woff = jnp.where(okv, p % bs, 0)
+                pos0 = jnp.where(active, pos, 0)
+                G, kc, vc = gen._verify_rows_paged(
+                    emb_p, blk_stack, head_p, kc, vc, vtok, pos0,
+                    epos, tbl, wblk, woff, shard=shard)
+                g = jnp.argmax(G, axis=-1).astype(jnp.int32)
+
+                if sampled:
+                    # target's FILTERED log-dist at each proposal's
+                    # position: G_j is the target after consuming v_j
+                    # — the dist proposal v_{j+1} is judged against
+                    Pfull = jax.vmap(
+                        lambda Gj: _filtered_logprobs_rows(
+                            Gj, temp, tk, tp),
+                        in_axes=1, out_axes=1)(G[:, :K])
+                    Qfull = jnp.swapaxes(dlps[:K], 0, 1)  # [B, K, V]
+                    ptok = v[:, 1:, None]
+                    logp = jnp.take_along_axis(Pfull, ptok,
+                                               axis=2)[..., 0]
+                    logq = jnp.take_along_axis(Qfull, ptok,
+                                               axis=2)[..., 0]
+                    u = jax.vmap(
+                        lambda k: jax.random.uniform(k, (K,)))(
+                        fold_rows(rkey, W + 1))
+                    c, rem_after, n_eval, rej = \
+                        _speculative.accept_mixed(
+                            greedy_row, v, g, logp, logq, u, active,
+                            rem, state["eos"], kcap=kcap)
+                else:
+                    c, rem_after = _speculative.accept_greedy(
+                        v, g, active, rem, state["eos"], kcap=kcap)
+                    n_eval = jnp.minimum(
+                        jnp.clip(jnp.minimum(K, rem - 1), 0, K),
+                        jnp.clip(kcap, 0, K))
+                    n_eval = jnp.where(active, n_eval,
+                                       0).astype(jnp.int32)
+                    rej = jnp.zeros((B,), jnp.bool_)
+
+                sel = jnp.maximum(c - 1, 0)
+                base = G[jnp.arange(B), sel]
+                if sampled:
+                    ridx = jnp.clip(c - 1, 0, K - 1)
+                    Prow = Pfull[jnp.arange(B), ridx]
+                    Qrow = Qfull[jnp.arange(B), ridx]
+                    res = _speculative.residual_logits(Prow, Qrow)
+                    # clamp the residual's -inf zeros to a finite
+                    # floor: exp(-1e30) is exactly 0 in f32 (same
+                    # draw), but the watchdog's finiteness screen and
+                    # the sanitizer would read -inf rows as poisoned
+                    res = jnp.maximum(res, jnp.float32(-1e30))
+                    new_logits = jnp.where(rej[:, None], res, base)
+                    new_rawlg = jnp.where(active, rej, state["rawlg"])
+                else:
+                    new_logits = base
+                    new_rawlg = state["rawlg"]
+                state = {
+                    "pos": jnp.where(active, pos + c, pos),
+                    "remaining": jnp.where(active, rem_after, rem),
+                    "eos": state["eos"],
+                    "logits": jnp.where(active[:, None], new_logits,
+                                        state["logits"]),
+                    "key": newk,
+                    "temp": temp,
+                    "tk": tk,
+                    "tp": tp,
+                    "table": tbl,
+                    "dtable": dtbl,
+                    "rawlg": new_rawlg,
+                }
+                rows = jnp.arange(B)[:, None]
+                keep = active[:, None] & (jidx < c[:, None])
+                cols = jnp.where(keep, emitted[:, None] + jidx, R * W)
+                staged = staged.at[rows, cols].set(v)
+                emitted = emitted + c
+                # per-slot tallies — EOS flush adjustment as in
+                # _spec_fn, but kept [B] so the host can attribute
+                # acceptance to tenants and feed the controller
+                prop_i = jnp.where((rem_after == 0) & (c < rem),
+                                   jnp.maximum(c - 1, 0), n_eval)
+                prop = prop + jnp.where(active, prop_i, 0)
+                acc = acc + jnp.maximum(c - 1, 0)
+                return (kc, vc, state, staged, emitted, prop, acc), \
+                    None
+
+            staged0 = jnp.zeros((B, R * W + 1), jnp.int32)
+            emitted0 = jnp.zeros((B,), jnp.int32)
+            zeros_b = jnp.zeros((B,), jnp.int32)
+            (kc, vc, state, staged, emitted, prop, acc), _ = \
+                jax.lax.scan(round_body,
+                             (kc, vc, state, staged0, emitted0,
+                              zeros_b, zeros_b),
                              None, length=R)
             n_alive = jnp.sum((state["remaining"] > 0)
                               .astype(jnp.int32))
@@ -1892,6 +2284,7 @@ class GenerationServer:
                 state["table"], table_row[None], (slot, 0)),
             "dtable": jax.lax.dynamic_update_slice(
                 state["dtable"], dtable_row[None], (slot, 0)),
+            "rawlg": state["rawlg"].at[slot].set(False),
         }
 
     def _admit_miss_fn(self, tb: int, use_draft: bool = True):
@@ -1943,7 +2336,8 @@ class GenerationServer:
         return fn
 
     def _admit_hit_fn(self, sb: int, matched: int, dtb: int = 0,
-                      nfill: int = 0, use_draft: bool = True):
+                      nfill: int = 0, use_draft: bool = True,
+                      dmatched: int = 0, dsb: int = 0):
         """Prefix-HIT admission program (cached per (suffix bucket,
         matched blocks, draft bucket, tier fills)): gather the
         ``matched`` cached blocks as the key prefix, chunked-prefill
@@ -1962,12 +2356,15 @@ class GenerationServer:
         one, and byte parity holds through the spill→fetch round
         trip.
 
-        With speculation on, the DRAFT still prefills the FULL prompt
-        (its blocks are never prefix-shared, so there is nothing
-        cached to skip) at its own pow2 bucket ``dtb`` — the hit
-        path's prefill saving applies to the target's n layers, the
-        draft re-pays its d cheap ones."""
-        key = ("hit", sb, matched, dtb, nfill, bool(use_draft))
+        With speculation on, the DRAFT prefills too — over the FULL
+        prompt at its own pow2 bucket ``dtb`` on a draft-cache miss,
+        or (``dmatched`` > 0, ISSUE 20) chunked over only the suffix
+        past its ``dmatched`` cached blocks (bucket ``dsb``), with
+        the draft prefix gathered from the pool's first d layers the
+        same way the target's is — so a warm prefix costs d cheap
+        layers over the suffix instead of over the whole prompt."""
+        key = ("hit", sb, matched, dtb, nfill, bool(use_draft),
+               dmatched, dsb)
         if key in self._admit_cache:
             return self._admit_cache[key]
         gen = self._gen
@@ -2000,11 +2397,32 @@ class GenerationServer:
             kc = self._scatter_rows(kc, ks, phys)
             vc = self._scatter_rows(vc, vs, phys)
             if spec is not None:
-                demb_p, dblk, dhead_p, dprompt, dphys = draft_ops
-                dblk = jax.tree_util.tree_map(
-                    lambda a: a[:spec.draft.n_layers], dblk)
-                _, dks, dvs = spec.draft.gen._prefill_rows(
-                    demb_p, dblk, dhead_p, dprompt, t0, shard=shard)
+                dl = spec.draft.n_layers
+                if dmatched:
+                    # draft-cache HIT: gather the draft prefix out of
+                    # the pool's first d layers, chunk-prefill only
+                    # the draft suffix (logits discarded — rounds
+                    # re-feed from the anchor)
+                    (demb_p, dblk, dhead_p, dsuffix, dprefix_phys,
+                     dphys) = draft_ops
+                    dblk = jax.tree_util.tree_map(
+                        lambda a: a[:dl], dblk)
+                    dgather = lambda pool: jnp.take(
+                        pool[:dl], dprefix_phys, axis=1) \
+                        .transpose(0, 2, 1, 3, 4) \
+                        .reshape(dl, 1, h, dmatched * bs, dh)
+                    dpk, dpv = dgather(kc), dgather(vc)
+                    dp0 = dmatched * bs
+                    _, dks, dvs = spec.draft.gen._prefill_rows_chunked(
+                        demb_p, dblk, dhead_p, dsuffix, dpk, dpv,
+                        jnp.int32(dp0), t0 - dp0 - 1, shard=shard)
+                else:
+                    demb_p, dblk, dhead_p, dprompt, dphys = draft_ops
+                    dblk = jax.tree_util.tree_map(
+                        lambda a: a[:dl], dblk)
+                    _, dks, dvs = spec.draft.gen._prefill_rows(
+                        demb_p, dblk, dhead_p, dprompt, t0,
+                        shard=shard)
                 kc = self._scatter_rows(kc, dks, dphys)
                 vc = self._scatter_rows(vc, dvs, dphys)
             state = self._arm_slot(state, logits, slot, t0, n_new,
@@ -2074,9 +2492,31 @@ class GenerationServer:
                 fresh = plan.phys[matched:matched + n_sc]
                 scatter_phys = np.zeros((n_sc,), np.int32)
                 scatter_phys[:len(fresh)] = fresh
-                dtb = (-(-_bucket(req.t0, self.max_len) // bs) * bs
-                       if use_draft else 0)
-                extra = draft_ops(dtb) if use_draft else ()
+                dmatched = plan.dmatched if use_draft else 0
+                if dmatched:
+                    # draft-cache hit (ISSUE 20): chunk-prefill only
+                    # the draft suffix past its cached blocks
+                    dtb = 0
+                    dsuffix = req.prompt[dmatched * bs:]
+                    dsb = -(-_bucket(len(dsuffix),
+                                     self.max_len) // bs) * bs
+                    dpadded = np.zeros((1, dsb), np.int32)
+                    dpadded[0, :len(dsuffix)] = dsuffix
+                    n_dc = dsb // bs
+                    dfresh = plan.dphys[dmatched:dmatched + n_dc]
+                    dscatter = np.zeros((n_dc,), np.int32)
+                    dscatter[:len(dfresh)] = dfresh
+                    demb_p, dblk, dhead_p = self._draft_params
+                    extra = (demb_p, dblk, dhead_p,
+                             jnp.asarray(dpadded),
+                             jnp.asarray(plan.dphys[:dmatched],
+                                         jnp.int32),
+                             jnp.asarray(dscatter))
+                else:
+                    dsb = 0
+                    dtb = (-(-_bucket(req.t0, self.max_len) // bs) * bs
+                           if use_draft else 0)
+                    extra = draft_ops(dtb) if use_draft else ()
                 nfill = len(plan.fills)
                 if nfill:
                     # host-tier restore operands: ONE stacked H2D per
@@ -2093,7 +2533,7 @@ class GenerationServer:
                 else:
                     fill_ops = ()
                 out = self._admit_hit_fn(sb, matched, dtb, nfill,
-                                         use_draft)(
+                                         use_draft, dmatched, dsb)(
                     emb_p, blk_stack, head_p, kc, vc, state,
                     jnp.asarray(padded), np.int32(p0),
                     np.int32(req.t0 - p0 - 1), np.int32(req.t0),
@@ -2141,6 +2581,8 @@ class GenerationServer:
             self._ids[slot, :req.t0] = req.prompt
             if self.prefix_cache:
                 self._register_prefix_locked(plan)
+                if use_draft and plan.dphys:
+                    self._register_draft_prefix_locked(plan)
             if matched:
                 self._n_prefix_hits += 1
             else:
@@ -2318,10 +2760,12 @@ class GenerationServer:
                         jnp.isfinite(state["logits"]).all(axis=1))
                     pos_h = np.asarray(state["pos"])
                     rem_h = np.asarray(state["remaining"])
-            except RuntimeError:
+            except (RuntimeError, ValueError):
                 # a still-running donating dispatch consumed a buffer
                 # between the is_deleted probe and the read (backends
-                # honor donation eagerly): nothing is salvageable
+                # honor donation eagerly; jax raises ValueError for a
+                # deleted/donated buffer, same as the export_prefix
+                # race): nothing is salvageable
                 pool_alive = False
             now = time.monotonic()
             victims = {}                     # slot -> why
@@ -2373,7 +2817,12 @@ class GenerationServer:
                 bad_cached = [b for b in self._block_hash
                               if not bool(blk_fin[b])]
                 for b in bad_cached:
-                    del self._prefix_map[self._block_hash.pop(b)]
+                    hsh = self._block_hash.pop(b)
+                    if b in self._draft_cached:
+                        self._draft_cached.discard(b)
+                        self._dprefix_map.pop(hsh, None)
+                    else:
+                        del self._prefix_map[hsh]
                     self._evictable.pop(b, None)
                     if self._block_ref[b] == 0:
                         self._blocks_free.append(b)
@@ -2412,6 +2861,10 @@ class GenerationServer:
                                            0),
                         "dtable": jnp.where(m[:, None],
                                             state["dtable"], 0),
+                        # a kept sampled slot's held RESIDUAL survives
+                        # with its flag (finite by the -1e30 clamp, so
+                        # log_fin kept it); victims reset to plain
+                        "rawlg": jnp.where(m, state["rawlg"], False),
                     }
                     n_blk_salvaged = int(bmask.sum())
                     n_blk_dropped = len(used_before
@@ -2634,26 +3087,54 @@ class GenerationServer:
                 with self._lock:
                     if self._epoch != my_epoch:
                         return
-                    live = list(self._active.values())
+                    live_items = list(self._active.items())
+                    live = [r for _, r in live_items]
                     k_drain = max(r.n_new - r.emitted for r in live)
                     sampled = any(r.temperature > 0.0 for r in live)
                     spec_off = self._spec_off
+                    draft_cap = self._draft_k_cap
                 queue_busy = n_pending > 0 or not self._queue.empty()
-                # speculative rounds serve ALL-GREEDY pools (the
-                # greedy acceptance rule has no rejection-sampling
-                # form here); any live sampled slot falls the whole
-                # pool back to the plain scan for those ticks —
-                # correctness is unaffected (the draft KV just goes
-                # stale, which costs later acceptance, and the
-                # verification recomputes every committed token with
-                # the target anyway)
-                # ... and rung 3 of the degradation ladder suspends
-                # speculation outright (no draft compute at all) —
-                # the flag flips back when the rung clears, and the
-                # only cost in between is stale draft KV
-                use_spec = (self._spec is not None and not sampled
-                            and not spec_off)
+                # speculative rounds serve MIXED pools (ISSUE 20):
+                # greedy rows run the unchanged greedy acceptance,
+                # sampled rows Leviathan rejection resampling — both
+                # through one flat-row verify.  Only the degradation
+                # ladder's ``spec_off`` rung suspends speculation
+                # outright (no draft compute at all); the flag flips
+                # back when the rung clears, and the only cost in
+                # between is stale draft KV (a held residual survives
+                # the fallback — ``rawlg`` rows sample it through the
+                # plain scan's pick_sampled)
+                use_spec = self._spec is not None and not spec_off
+                legacy_spec = (use_spec and not sampled
+                               and not self._spec.adaptive
+                               and draft_cap is None)
+                kcap_arr = None
                 if use_spec:
+                    if legacy_spec:
+                        # the PR 11 program, byte-for-byte: fixed-K
+                        # all-greedy pools keep its exact compile
+                        K_disp = self._spec.k
+                    else:
+                        # per-slot draft depth: the acceptance
+                        # controller's pick (adaptive) or the fixed k,
+                        # both clamped by the degrade ladder's cap;
+                        # the dispatch compiles at the pool max and a
+                        # [B] kcap operand masks each slot down to its
+                        # own depth (depths change per tick without
+                        # recompiling)
+                        kcap_arr = np.zeros((self.n_slots,), np.int32)
+                        ctl = self._spec_ctl
+                        for slot, r in live_items:
+                            if self._spec.adaptive:
+                                k_i = ctl.k_for((r.tenant, r.pkey),
+                                                cap=draft_cap)
+                            elif draft_cap is not None:
+                                k_i = max(1, min(self._spec.k,
+                                                 draft_cap))
+                            else:
+                                k_i = self._spec.k
+                            kcap_arr[slot] = k_i
+                        K_disp = int(max(1, kcap_arr.max()))
                     # adaptive round count, the scan-length rule's
                     # analogue: a single round while admission is
                     # pending (a join waits at most one W-wide round
@@ -2664,7 +3145,7 @@ class GenerationServer:
                     R = (1 if queue_busy
                          else min(self._spec.rounds,
                                   _pow2_floor(k_drain)))
-                    k = R * (self._spec.k + 1)   # watchdog scale: the
+                    k = R * (K_disp + 1)   # watchdog scale: the
                     # dispatch legitimately runs ~R draft scans + R
                     # W-wide verifications
                 else:
@@ -2704,12 +3185,20 @@ class GenerationServer:
                     with prof.measure("verify" if use_spec
                                       else "decode_tick",
                                       devices=self._device_labels):
-                        if use_spec:
+                        if use_spec and legacy_spec:
                             demb_p, dblk, dhead_p = self._draft_params
                             (kc, vc, state, toks, emitted, n_alive,
                              prop, acc) = self._spec_fn(R)(
                                 emb_p, blk_stack, head_p, demb_p, dblk,
                                 dhead_p, kc_in, vc_in, state_in)
+                        elif use_spec:
+                            demb_p, dblk, dhead_p = self._draft_params
+                            (kc, vc, state, toks, emitted, n_alive,
+                             prop, acc) = self._spec_fn2(
+                                R, K_disp, sampled)(
+                                emb_p, blk_stack, head_p, demb_p, dblk,
+                                dhead_p, kc_in, vc_in, state_in,
+                                jnp.asarray(kcap_arr))
                         else:
                             kc, vc, state, toks, emitted, n_alive = \
                                 self._decode_scan(k, sampled)(
@@ -2724,8 +3213,17 @@ class GenerationServer:
                         emit_h = np.asarray(emitted)
                         rem_h = np.asarray(state["remaining"])
                         alive_h = int(n_alive)
-                    if use_spec:
+                    prop_h = acc_h = None
+                    if use_spec and legacy_spec:
                         n_prop, n_acc = int(prop), int(acc)
+                    elif use_spec:
+                        # the kcap program tallies PER SLOT, so the
+                        # host can attribute acceptance to tenants and
+                        # feed the controller
+                        prop_h = np.asarray(prop)
+                        acc_h = np.asarray(acc)
+                        n_prop = int(prop_h.sum())
+                        n_acc = int(acc_h.sum())
                     _HOST_SYNCS.inc()
                     self._mark_tick(my_epoch, None)
                 # device-truth occupancy at scan end (the host view is
@@ -2748,11 +3246,13 @@ class GenerationServer:
                     # dispatch shape (R rounds x W-wide verify)
                     _TICKS.inc(R)
                     _SCANS.labels(
-                        k=f"spec{R}x{self._spec.k + 1}").inc()
+                        k=f"spec{R}x{K_disp + 1}").inc()
+                    _SPEC_ADAPTIVE_K.set(K_disp)
                     if n_prop:
                         _SPEC_PROPOSED.inc(n_prop)
                     if n_acc:
                         _SPEC_ACCEPTED.inc(n_acc)
+                    tenant_rows, obs = [], []
                     with self._lock:
                         self._n_spec_proposed += n_prop
                         self._n_spec_accepted += n_acc
@@ -2760,6 +3260,30 @@ class GenerationServer:
                             _SPEC_ACCEPT_RATE.set(
                                 self._n_spec_accepted
                                 / self._n_spec_proposed)
+                        if prop_h is not None:
+                            for slot, r in live_items:
+                                p_i = int(prop_h[slot])
+                                if p_i <= 0:
+                                    continue
+                                a_i = int(acc_h[slot])
+                                ent = self._tenant_spec.setdefault(
+                                    r.tenant, [0, 0])
+                                ent[0] += p_i
+                                ent[1] += a_i
+                                tenant_rows.append(
+                                    (r.tenant, ent[0], ent[1]))
+                                obs.append(((r.tenant, r.pkey),
+                                            p_i, a_i))
+                    # gauges + controller OUTSIDE the server lock (the
+                    # controller has its own; registry sets are
+                    # independently locked)
+                    for tenant, p_tot, a_tot in tenant_rows:
+                        _TENANT_SPEC_ACCEPT.labels(
+                            tenant=tenant).set(a_tot / p_tot)
+                    ctl = self._spec_ctl
+                    if ctl is not None:
+                        for okey, p_i, a_i in obs:
+                            ctl.observe(okey, p_i, a_i)
                 else:
                     _TICKS.inc(k)
                     _SCANS.labels(k=str(k)).inc()

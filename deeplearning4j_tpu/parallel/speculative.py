@@ -175,28 +175,40 @@ class SpecConfig:
     """Parsed ``GenerationServer(speculative={...})`` config: ``k``
     draft proposals per round (the verification width is k+1),
     ``rounds`` — the max rounds fused into one dispatch (the scan-
-    length analogue of ``tick_batch``; adaptive, pow2-quantized), and
-    the :class:`DraftModel`."""
+    length analogue of ``tick_batch``; adaptive, pow2-quantized), the
+    :class:`DraftModel`, and the adaptive-K knobs: ``adaptive=True``
+    lets the :class:`AcceptanceController` pick each dispatch's draft
+    depth within ``[1, k_max]`` (``k_max`` defaults to ``k``; ``k``
+    stays the fixed depth when adaptive is off)."""
 
-    def __init__(self, k: int, rounds: int, draft: DraftModel):
+    def __init__(self, k: int, rounds: int, draft: DraftModel,
+                 adaptive: bool = False, k_max: Optional[int] = None):
         self.k = int(k)
         self.rounds = int(rounds)
         self.draft = draft
+        self.adaptive = bool(adaptive)
+        self.k_max = self.k if k_max is None else int(k_max)
         if self.k < 1:
             raise ValueError("speculative k must be >= 1")
         if self.rounds < 1:
             raise ValueError("speculative rounds must be >= 1")
+        if self.k_max < self.k:
+            raise ValueError(
+                f"speculative k_max={self.k_max} must be >= k={self.k} "
+                "(k is the fixed/startup depth; the controller adapts "
+                "within [1, k_max])")
 
     @classmethod
     def build(cls, gen: TransformerGenerator,
               spec: dict) -> "SpecConfig":
         spec = dict(spec)
         unknown = set(spec) - {"k", "rounds", "draft_layers",
-                               "draft_net"}
+                               "draft_net", "adaptive", "k_max"}
         if unknown:
             raise ValueError(
                 f"unknown speculative key(s) {sorted(unknown)} "
-                "(expected k / rounds / draft_layers / draft_net)")
+                "(expected k / rounds / draft_layers / draft_net / "
+                "adaptive / k_max)")
         draft_net = spec.get("draft_net")
         if draft_net is not None:
             if spec.get("draft_layers") is not None:
@@ -206,16 +218,22 @@ class SpecConfig:
             draft = make_draft(gen, draft_net)
         else:
             draft = make_self_draft(gen, spec.get("draft_layers"))
-        return cls(spec.get("k", 4), spec.get("rounds", 2), draft)
+        return cls(spec.get("k", 4), spec.get("rounds", 2), draft,
+                   adaptive=spec.get("adaptive", False),
+                   k_max=spec.get("k_max"))
 
 
-def accept_greedy(v, g, active, remaining, eos):
+def accept_greedy(v, g, active, remaining, eos, kcap=None):
     """The greedy acceptance rule on one verified chunk.
 
     ``v`` [B, W] — the verified tokens (anchor + K proposals);
     ``g`` [B, W] — the target's own argmax after each of them
     (``g[:, j] = argmax(G_j)``); ``active`` [B] bool; ``remaining``
-    [B] int32 budgets; ``eos`` [B] int32 (-1 disables).
+    [B] int32 budgets; ``eos`` [B] int32 (-1 disables); ``kcap``
+    [B] int32 (optional) — a per-slot draft-depth cap from the
+    acceptance controller: proposals at index >= kcap[b] were never
+    drafted for slot b (the dispatch runs at the pool-max K), so they
+    can never commit.
 
     Returns ``(commit, remaining_after)``: ``commit[b]`` tokens
     ``v[b, :commit[b]]`` are byte-identical to what non-speculative
@@ -227,6 +245,9 @@ def accept_greedy(v, g, active, remaining, eos):
     ``hit_eos`` does (``remaining_after`` drops to 0)."""
     W = v.shape[1]
     match = (v[:, 1:] == g[:, :-1]).astype(jnp.int32)       # [B, K]
+    if kcap is not None:
+        match = jnp.where(
+            jnp.arange(W - 1)[None, :] < kcap[:, None], match, 0)
     a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)         # leading 1s
     c = jnp.minimum(1 + a, remaining)
     idx = jnp.arange(W)[None, :]
@@ -239,3 +260,239 @@ def accept_greedy(v, g, active, remaining, eos):
     c = jnp.where(active, c, 0)
     rem_after = jnp.where(active, rem_after, remaining)
     return c.astype(jnp.int32), rem_after.astype(jnp.int32)
+
+
+def accept_sampled(v, logp, logq, u, active, remaining, eos,
+                   kcap=None):
+    """Rejection-sampling acceptance (Leviathan et al. / Chen et al.)
+    on one verified chunk — the sampled-slot analogue of
+    :func:`accept_greedy`, preserving the EXACT target sampling
+    distribution.
+
+    ``v`` [B, W] — verified tokens (anchor + K proposals); ``logp`` /
+    ``logq`` [B, K] — log-probability of proposal p_{i+1} under the
+    TARGET's and the DRAFT's filtered sampling distribution at its
+    position; ``u`` [B, K] — per-proposal uniforms from the slot's own
+    PRNG; ``active`` / ``remaining`` / ``eos`` / ``kcap`` as in
+    :func:`accept_greedy`.
+
+    Proposal i is accepted with probability
+    ``min(1, p_target(x_i) / p_draft(x_i))`` — i.e. iff
+    ``u_i < exp(min(0, logp_i - logq_i))`` — and only while every
+    earlier proposal was accepted.  The anchor always commits (it was
+    drawn from the target's own held distribution).  Returns
+    ``(commit, remaining_after, n_eval, rejected)``: ``n_eval[b]`` is
+    how many proposals were actually evaluated for slot b (the
+    per-slot proposed count — capped by kcap and by the remaining
+    budget), and ``rejected[b]`` marks slots whose run ended at a
+    genuine rejection (not budget / EOS exhaustion): those slots'
+    NEXT token must come from the normalized residual
+    ``max(0, p_target - p_draft)`` (:func:`residual_logits`), which
+    the caller holds as the slot's next-anchor distribution."""
+    B, W = v.shape
+    K = W - 1
+    n_eval = jnp.clip(jnp.minimum(K, remaining - 1), 0, K)
+    if kcap is not None:
+        n_eval = jnp.minimum(n_eval, jnp.clip(kcap, 0, K))
+    idx = jnp.arange(K)[None, :]
+    ok = (u < jnp.exp(jnp.minimum(logp - logq, 0.0)))
+    ok = ok & (idx < n_eval[:, None])
+    a = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+    rejected = a < n_eval
+    c = jnp.minimum(1 + a, remaining)
+    widx = jnp.arange(W)[None, :]
+    hit = ((v == eos[:, None]) & (eos[:, None] >= 0)
+           & (widx < c[:, None]))
+    any_hit = jnp.any(hit, axis=1)
+    first = jnp.argmax(hit, axis=1)
+    c = jnp.where(any_hit, first + 1, c)
+    rem_after = jnp.where(any_hit, 0, remaining - c)
+    rejected = rejected & ~any_hit & (rem_after > 0) & active
+    c = jnp.where(active, c, 0)
+    rem_after = jnp.where(active, rem_after, remaining)
+    return (c.astype(jnp.int32), rem_after.astype(jnp.int32),
+            jnp.where(active, n_eval, 0).astype(jnp.int32), rejected)
+
+
+def accept_mixed(greedy_row, v, g, logp, logq, u, active, remaining,
+                 eos, kcap=None):
+    """Per-row dispatch between the two acceptance rules for a MIXED
+    pool (greedy + sampled slots in one tick).  ``greedy_row`` [B]
+    bool selects :func:`accept_greedy` rows — their commit counts are
+    computed by the identical greedy rule, so greedy slots stay
+    byte-identical to non-speculative decode regardless of what the
+    sampled slots in the same dispatch do.  Returns ``(commit,
+    remaining_after, n_eval, rejected)`` with ``rejected`` always
+    False on greedy rows (a greedy mismatch is corrected by the next
+    anchor's argmax, not a residual draw)."""
+    cg, rg = accept_greedy(v, g, active, remaining, eos, kcap=kcap)
+    cs, rs, n_eval, rej = accept_sampled(
+        v, logp, logq, u, active, remaining, eos, kcap=kcap)
+    c = jnp.where(greedy_row, cg, cs)
+    rem_after = jnp.where(greedy_row, rg, rs)
+    return c, rem_after, n_eval, rej & ~greedy_row
+
+
+def residual_logits(logp_t, logq_d):
+    """Log of the normalized rejection residual
+    ``max(0, p_target - p_draft)`` — the distribution a rejected
+    position's replacement token must be drawn from for the committed
+    stream to stay exactly target-distributed.  ``logp_t`` / ``logq_d``
+    [..., V] log-probabilities of the two FILTERED sampling
+    distributions at the rejected position.  Returned as UNNORMALIZED
+    log-weights (-inf where the residual is zero) — a categorical draw
+    normalizes implicitly.  Degenerate case p_target <= p_draft
+    everywhere (numerically possible only when the dists coincide,
+    where rejection has probability ~0) falls back to the target
+    distribution."""
+    diff = jnp.exp(logp_t) - jnp.exp(logq_d)
+    pos = diff > 0.0
+    res = jnp.where(pos, jnp.log(jnp.where(pos, diff, 1.0)), -jnp.inf)
+    return jnp.where(jnp.any(pos, axis=-1, keepdims=True), res, logp_t)
+
+
+class AcceptanceController:
+    """Self-tuning draft depth from observed acceptance.
+
+    Keeps a per-key EWMA of the per-proposal acceptance probability
+    ``alpha`` (key = whatever the server hashes a slot to — tenant +
+    leading prefix block in practice) plus a global aggregate, and
+    picks the draft depth k in ``[1, k_max]`` maximizing the expected
+    speedup of a spec round,
+
+        E(tokens | k) / cost(k)  with  E = (1 - a^(k+1)) / (1 - a),
+        cost = k * draft_cost + 1
+
+    — the classic speculative-decode throughput model (draft_cost =
+    draft step cost as a fraction of a target step, e.g.
+    ``draft_layers / n_layers`` for a self-draft; the +1 is the
+    batched verify, which runs at ~one target step regardless of k).
+
+    Cold keys fall back to the global EWMA; a cold GLOBAL seeds itself
+    from the ``generation_server_spec_{proposed,accepted}_total``
+    counter history when a :class:`~..telemetry.tsdb.TimeSeriesStore`
+    is attached (the PR 16 recorder beacons them), and to ``k_max``
+    (optimistic — misprediction costs one round of drafting, while a
+    timid start forfeits real speedup) when there is no history at
+    all.  Purely host-side: observations arrive from the dispatch's
+    host-sync path, decisions feed the NEXT dispatch — nothing here
+    touches the compiled programs."""
+
+    SERIES_PROPOSED = "generation_server_spec_proposed_total"
+    SERIES_ACCEPTED = "generation_server_spec_accepted_total"
+
+    def __init__(self, k_max: int, draft_cost: float,
+                 ewma: float = 0.2, min_obs: int = 32,
+                 store=None, window_s: float = 120.0):
+        if not 1 <= int(k_max):
+            raise ValueError("k_max must be >= 1")
+        self.k_max = int(k_max)
+        self.draft_cost = max(1e-3, float(draft_cost))
+        self.ewma = float(ewma)
+        self.min_obs = int(min_obs)
+        self.window_s = float(window_s)
+        self._store = store
+        self._keys = {}          # key -> [alpha, n_proposed]
+        self._global = None      # alpha
+        self._global_n = 0
+        import threading
+        self._lock = threading.Lock()
+
+    def attach_store(self, store) -> None:
+        with self._lock:
+            self._store = store
+
+    def reset(self) -> None:
+        """Drop all acceptance state, returning every key to the
+        optimistic cold start (bench/ops hook — e.g. pinning
+        ``k_for`` to the degrade cap so each depth's compiled
+        program can be warmed deterministically)."""
+        with self._lock:
+            self._keys.clear()
+            self._global = None
+            self._global_n = 0
+
+    def observe(self, key, proposed: int, accepted: int) -> None:
+        """Fold one slot-round observation in.  ``proposed`` counts
+        only genuinely evaluated proposals (n_eval), so budget/EOS
+        truncation never reads as rejection."""
+        proposed = int(proposed)
+        if proposed <= 0:
+            return
+        r = min(1.0, max(0.0, int(accepted) / proposed))
+        with self._lock:
+            ent = self._keys.get(key)
+            if ent is None:
+                self._keys[key] = [r, proposed]
+            else:
+                ent[0] += self.ewma * (r - ent[0])
+                ent[1] += proposed
+            if self._global is None:
+                self._global = r
+            else:
+                self._global += self.ewma * (r - self._global)
+            self._global_n += proposed
+
+    def rate(self, key) -> Optional[float]:
+        """Best current acceptance estimate for ``key`` (per-key when
+        warm, else global, else TSDB-seeded, else None)."""
+        with self._lock:
+            ent = self._keys.get(key)
+            if ent is not None and ent[1] >= self.min_obs:
+                return ent[0]
+            if self._global_n >= self.min_obs:
+                return self._global
+            store = self._store
+        seeded = self._store_rate(store)
+        if seeded is not None:
+            return seeded
+        with self._lock:
+            if ent is not None:
+                return ent[0]
+            return self._global
+
+    def k_for(self, key, cap: Optional[int] = None) -> int:
+        """Draft depth for the next round touching ``key``, within
+        ``[1, min(k_max, cap)]`` (``cap`` is the degrade ladder's
+        ``shrink_draft_k`` rung talking)."""
+        hi = self.k_max if cap is None else max(1, min(self.k_max,
+                                                       int(cap)))
+        a = self.rate(key)
+        if a is None:
+            return hi
+        return self._best_k(a, hi)
+
+    def _best_k(self, alpha: float, hi: int) -> int:
+        a = min(0.98, max(0.0, float(alpha)))
+        best_k, best_s = 1, -1.0
+        for k in range(1, hi + 1):
+            e = (1.0 - a ** (k + 1)) / (1.0 - a)
+            s = e / (k * self.draft_cost + 1.0)
+            if s > best_s + 1e-12:
+                best_k, best_s = k, s
+        return best_k
+
+    def _store_rate(self, store) -> Optional[float]:
+        if store is None:
+            return None
+        try:
+            import time as _time
+            now = _time.time()
+            rp = store.rate(self.SERIES_PROPOSED,
+                            now - self.window_s, now)
+            ra = store.rate(self.SERIES_ACCEPTED,
+                            now - self.window_s, now)
+        except Exception:
+            return None
+        if not rp or ra is None:
+            return None
+        return min(1.0, max(0.0, ra / rp))
+
+    def snapshot(self) -> dict:
+        """Controller introspection for ``stats()`` / debugging."""
+        with self._lock:
+            return {
+                "keys": len(self._keys),
+                "global_rate": self._global,
+                "global_proposed": self._global_n,
+            }

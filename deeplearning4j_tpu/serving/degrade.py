@@ -1,31 +1,36 @@
 """Graceful-degradation ladder for the serving fleet (ISSUE 18).
 
 When the SLO engine projects a sustained error-budget burn, the fleet
-should get CHEAPER before it gets smaller: shedding load is rung FOUR,
+should get CHEAPER before it gets smaller: shedding load is rung FIVE,
 not the first response.  This module is the state machine between the
 two — it reads the engine's admission projection (the same TSDB-backed
 burn history the alert condition folds) and walks the fleet down a
 ladder of progressively lossier-but-reversible economies:
 
-====  =============  ===================================================
-rung  name           effect while the rung holds
-====  =============  ===================================================
-0     normal         nothing — the ladder is invisible
-1     shrink_budget  new requests' ``n_new`` capped to
-                     ``n_new_factor`` of what they asked for (shorter
-                     answers, same answers-per-second), and already-
-                     waiting work is demoted the same way
-2     force_greedy   sampling disabled (temperature 0): every decode
-                     rides the cheap deterministic path, and — because
-                     the decode server's speculative gate requires an
-                     all-greedy pool — spec verify stays CHEAP instead
-                     of being knocked out by one sampled straggler
-3     spec_off       speculative decoding suspended entirely (draft K
-                     dropped to 0): no draft compute, no verify ticks
-4     shed_batch     the batch tenant class is rejected at admission
-                     (typed ``AdmissionRejectedError`` with a
-                     retry-after hint) and its waiting work cancelled
-====  =============  ===================================================
+====  ==============  ==================================================
+rung  name            effect while the rung holds
+====  ==============  ==================================================
+0     normal          nothing — the ladder is invisible
+1     shrink_budget   new requests' ``n_new`` capped to
+                      ``n_new_factor`` of what they asked for (shorter
+                      answers, same answers-per-second), and already-
+                      waiting work is demoted the same way
+2     force_greedy    sampling disabled (temperature 0): every decode
+                      rides the cheap deterministic path — no
+                      per-slot filter/categorical math in the tick,
+                      and spec rounds skip the rejection-resampling
+                      machinery (greedy acceptance only)
+3     shrink_draft_k  the speculative draft depth capped to 1
+                      (``set_draft_k_cap``): the acceptance
+                      controller's k_max collapses, so each round
+                      drafts ONE token — most of speculation's win at
+                      a fraction of its draft compute
+4     spec_off        speculative decoding suspended entirely (draft K
+                      dropped to 0): no draft compute, no verify ticks
+5     shed_batch      the batch tenant class is rejected at admission
+                      (typed ``AdmissionRejectedError`` with a
+                      retry-after hint) and its waiting work cancelled
+====  ==============  ==================================================
 
 Rungs NEST: rung 3 implies 2 implies 1.  Ascent is immediate — a burn
 spike that clears threshold N lands on rung N this pass, because every
@@ -60,14 +65,14 @@ log = logging.getLogger("deeplearning4j_tpu")
 
 #: the ladder's rungs, mildest first; index == rung number
 RUNGS: Tuple[str, ...] = ("normal", "shrink_budget", "force_greedy",
-                          "spec_off", "shed_batch")
+                          "shrink_draft_k", "spec_off", "shed_batch")
 
 _RUNG_GAUGE = telemetry.gauge(
     "fleet_degrade_rung",
     "current degradation-ladder rung: 0 normal, 1 shrink_budget "
-    "(n_new capped), 2 force_greedy (sampling off), 3 spec_off "
-    "(draft K dropped), 4 shed_batch (batch class rejected at "
-    "admission)")
+    "(n_new capped), 2 force_greedy (sampling off), 3 shrink_draft_k "
+    "(draft depth capped to 1), 4 spec_off (draft K dropped), "
+    "5 shed_batch (batch class rejected at admission)")
 
 _FLIGHT = telemetry.get_flight_recorder()
 
@@ -76,19 +81,20 @@ class DegradeLadder:
     """The burn-driven degradation state machine.
 
     >>> ladder = DegradeLadder(fleet, engine,
-    ...                        thresholds=(2.0, 6.0, 10.0, 14.4))
+    ...                        thresholds=(2.0, 6.0, 8.0, 10.0, 14.4))
     >>> fleet.attach_degrade(ladder)     # admission shaping
     >>> ladder.start()                   # or: autoscaler drives it
 
     ``thresholds`` are the burn levels (units of the SLO budget-spend
-    rate, like the alert windows') at which rungs 1..4 engage;
+    rate, like the alert windows') at which rungs 1..5 engage;
     ``burn`` is injectable into :meth:`evaluate` for tests, otherwise
     the worst covered projection across the engine's specs.  The
     ``batch_tenants`` shed set defaults to the fleet accountant's
     ``klass="batch"`` tenants."""
 
     def __init__(self, fleet=None, engine=None, *,
-                 thresholds: Tuple[float, ...] = (2.0, 6.0, 10.0, 14.4),
+                 thresholds: Tuple[float, ...] = (2.0, 6.0, 8.0, 10.0,
+                                                  14.4),
                  hysteresis: float = 0.7,
                  hold_down_s: float = 2.0,
                  n_new_factor: float = 0.25,
@@ -138,7 +144,7 @@ class DegradeLadder:
 
     # -- configuration reads -------------------------------------------
     def shed_tenants(self) -> Tuple[str, ...]:
-        """The tenant set rung 4 sheds: the configured list, else the
+        """The tenant set rung 5 sheds: the configured list, else the
         fleet accountant's batch-class tenants, else nothing (a fleet
         with no batch class has nothing safe to shed)."""
         if self._batch_tenants is not None:
@@ -175,8 +181,9 @@ class DegradeLadder:
                                  else None),
             "min_n_new": self.min_n_new,
             "force_greedy": rung >= 2,
-            "spec": rung < 3,
-            "shed_tenants": (self.shed_tenants() if rung >= 4
+            "draft_k_cap": 1 if rung >= 3 else None,
+            "spec": rung < 4,
+            "shed_tenants": (self.shed_tenants() if rung >= 5
                              else ()),
         }
 
@@ -186,15 +193,18 @@ class DegradeLadder:
                         ) -> Tuple[int, Optional[dict], str]:
         """Shape ONE request at admission from the current rung:
         returns ``(n_new, sampling, verdict)`` with verdict one of
-        ``admit`` / ``degraded`` / ``reject``.  Reject (rung 4, batch
+        ``admit`` / ``degraded`` / ``reject``.  Reject (rung 5, batch
         tenant) costs the pool nothing — the router raises before any
         reserve.  Requests admitted at rung 0 pass through untouched,
-        which is the reversibility contract."""
+        which is the reversibility contract.  Rungs 3 and 4 act on
+        the REPLICAS (draft depth cap / spec off via
+        ``apply_degrade``), not on individual requests — nothing to
+        shape here."""
         with self._lock:
             rung = self._rung
         if rung <= 0:
             return int(n_new), sampling, "admit"
-        if rung >= 4 and str(tenant) in self.shed_tenants():
+        if rung >= 5 and str(tenant) in self.shed_tenants():
             return int(n_new), sampling, "reject"
         verdict = "admit"
         n_new = int(n_new)

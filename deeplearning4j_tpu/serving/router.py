@@ -871,15 +871,17 @@ class ServingFleet:
 
     def apply_degrade(self, max_n_new_factor: Optional[float] = None,
                       min_n_new: int = 1, force_greedy: bool = False,
+                      draft_k_cap: Optional[int] = None,
                       spec: bool = True,
                       shed_tenants: Iterable[str] = ()) -> None:
         """Actuate one degradation-ladder policy on the LIVE fleet
         (new admissions are shaped separately, per request): cap the
         wait lines' ``n_new`` budgets, flip waiting work to greedy,
-        suspend/resume speculative decoding per replica, and shed the
-        named tenants' waiting requests.  Idempotent — the ladder
-        calls it once per rung change with the FULL nested policy, so
-        re-applying a rung is harmless."""
+        cap each replica's speculative draft depth
+        (``shrink_draft_k``), suspend/resume speculative decoding per
+        replica, and shed the named tenants' waiting requests.
+        Idempotent — the ladder calls it once per rung change with the
+        FULL nested policy, so re-applying a rung is harmless."""
         shed = tuple(str(t) for t in shed_tenants)
         demoted = 0
         with self._lock:
@@ -909,6 +911,7 @@ class ServingFleet:
                 continue
             try:
                 srv.set_spec_enabled(spec)
+                srv.set_draft_k_cap(draft_k_cap)
                 demoted += srv.demote_waiting(
                     n_new_factor=max_n_new_factor,
                     force_greedy=force_greedy)
@@ -1459,7 +1462,8 @@ class ServingFleet:
                     inner = srv.submit_async(
                         req.prompt, req.n_new, eos_id=req.eos_id,
                         seed=req.seed, deadline_s=remaining,
-                        sampling=req.sampling, trace_id=req.trace_id)
+                        sampling=req.sampling, trace_id=req.trace_id,
+                        tenant=req.tenant)
             except RuntimeError:
                 # raced into a draining/shutdown replica: drop it from
                 # the candidate ranking and try the next one
@@ -1597,7 +1601,8 @@ class ServingFleet:
                 hedge = srv.submit_async(
                     req.prompt, req.n_new, eos_id=req.eos_id,
                     seed=req.seed, deadline_s=rem,
-                    sampling=req.sampling, trace_id=req.trace_id)
+                    sampling=req.sampling, trace_id=req.trace_id,
+                    tenant=req.tenant)
             except Exception:
                 continue             # raced drain/shutdown: no hedge
             committed = False

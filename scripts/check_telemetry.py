@@ -112,16 +112,22 @@ TIERED_KV_SERIES = [
     "kv_handoff_bytes_total",
 ]
 
-# Speculative-decode series (PR 11): the smoke below decodes through
-# a draft-verified server (full-depth self-draft -> acceptance is
-# exactly 1.0), so proposed/accepted and the acceptance-rate gauge
-# carry live values on the wire — and the output is byte-compared
-# against the non-speculative decode of the same prompt.
+# Speculative-decode series (PR 11 + ISSUE 20): the smoke below
+# decodes through a draft-verified server (full-depth self-draft ->
+# acceptance is exactly 1.0), so proposed/accepted and the
+# acceptance-rate gauge carry live values on the wire — and the
+# output is byte-compared against the non-speculative decode of the
+# same prompt.  A second, SAMPLED adaptive-K server (tenant-tagged
+# request) puts the adaptive-depth gauge and the per-tenant
+# acceptance series on the scrape too.
 SPEC_SERIES = [
     "generation_server_spec_proposed_total",
     "generation_server_spec_accepted_total",
     "generation_server_spec_acceptance_rate",
     'generation_server_scan_ticks_total{k="spec',
+    "generation_server_spec_adaptive_k",
+    'generation_server_tenant_spec_acceptance_rate'
+    '{tenant="spec-tenant"}',
 ]
 
 # Serving-fleet series (PR 9): the smoke below routes a 2-tenant
@@ -210,7 +216,7 @@ SLO_SERIES = [
 # Production front door (ISSUE 18): the smoke below induces a REAL
 # overload (100%-bad tenant traffic aged past the long burn window
 # through a real AlertEngine), lets the attached DegradeLadder walk a
-# real fleet up to rung 4 (admissions shaped, the batch class shed
+# real fleet up to rung 5 (admissions shaped, the batch class shed
 # with a typed retry-after) and back to 0, and races one deadline'd
 # request's hedge on the second replica — so the admission outcome
 # counters, the rung gauge, the hedge race counters and the
@@ -598,6 +604,38 @@ def main() -> int:
         problems.append("per-instance spec acceptance rate "
                         f"{spec_stats['spec_acceptance_rate']} != 1.0")
 
+    # -- sampled speculative decode + adaptive K (ISSUE 20): a
+    # tenant-tagged SAMPLED request through an adaptive-depth server
+    # puts the adaptive-K gauge and the per-tenant acceptance series
+    # on the scrape with real post-dispatch values ------------------
+    adaptive_k = registry.gauge("generation_server_spec_adaptive_k")
+    with GenerationServer(gpt, n_slots=2, max_len=32,
+                          tick_timeout_s=None,
+                          speculative={"k": 2, "rounds": 2,
+                                       "draft_layers": 2,
+                                       "adaptive": True,
+                                       "k_max": 3}) as ga:
+        samp_out = ga.submit(spec_prompt, n_new=6, sampling={
+            "temperature": 0.8, "top_k": 8, "seed": 5},
+            tenant="spec-tenant", timeout=300)
+        ctl_snap = ga._spec_ctl.snapshot()
+    if samp_out.shape != (12,) or not (
+            (samp_out >= 0).all() and (samp_out < 50).all()):
+        problems.append("sampled speculative decode returned a "
+                        f"malformed stream (shape {samp_out.shape})")
+    if not 1 <= adaptive_k.value <= 3:
+        problems.append("generation_server_spec_adaptive_k "
+                        f"{adaptive_k.value} outside [1, k_max=3]")
+    if ctl_snap["global_proposed"] < 1:
+        problems.append("acceptance controller observed no "
+                        "proposals from the sampled spec decode")
+    tenant_rate = registry.gauge(
+        "generation_server_tenant_spec_acceptance_rate",
+        labelnames=("tenant",)).labels(tenant="spec-tenant")
+    if not 0.0 <= tenant_rate.value <= 1.0:
+        problems.append("per-tenant spec acceptance rate "
+                        f"{tenant_rate.value} outside [0, 1]")
+
     # -- serving fleet: 2 replicas x 2 tenants through the admission
     # router — the repeated hot-tenant prompt must ride affinity to
     # the warm replica and score a real prefix hit THERE -------------
@@ -645,7 +683,7 @@ def main() -> int:
     # -- production front door (ISSUE 18): induce a REAL overload —
     # all-bad tenant traffic aged past the long burn window drives
     # the engine's admission projection, the attached ladder walks a
-    # real 2-replica fleet to rung 4 (budgets capped, batch shed with
+    # real 2-replica fleet to rung 5 (budgets capped, batch shed with
     # retry-after) and back down once the burn clears, and a
     # deadline'd request under hedge_slack_s races a hedge ---------
     from deeplearning4j_tpu.serving import (AdmissionRejectedError,
@@ -671,18 +709,18 @@ def main() -> int:
                       quotas={"bulk": TenantQuota(klass="batch")}
                       ) as dfleet:
         lad = DegradeLadder(dfleet, deg_eng,
-                            thresholds=(1.0, 2.0, 3.0, 4.0),
+                            thresholds=(1.0, 2.0, 3.0, 4.0, 5.0),
                             hold_down_s=0.0)
         dfleet.attach_degrade(lad)
         rung = lad.evaluate(now=0.6)     # real projection read
-        if rung != 4:
+        if rung != 5:
             problems.append(f"induced 10x burn drove the ladder to "
-                            f"rung {rung}, expected 4")
+                            f"rung {rung}, expected 5")
         try:
             dfleet.submit_async(np.asarray([1, 2, 3], np.int32), 4,
                                 tenant="bulk")
             problems.append("batch tenant admitted during the "
-                            "overload (rung 4 must shed)")
+                            "overload (rung 5 must shed)")
         except AdmissionRejectedError as e:
             if not e.retry_after_s > 0:
                 problems.append("shed batch tenant carried no "
@@ -690,7 +728,7 @@ def main() -> int:
         deg_out = dfleet.submit(np.asarray([5, 6, 7], np.int32), 8,
                                 tenant="chat", timeout=300)
         if deg_out.shape != (5,):        # n_new 8 -> capped 2
-            problems.append(f"rung 4 did not cap n_new: shape "
+            problems.append(f"rung 5 did not cap n_new: shape "
                             f"{deg_out.shape}, expected (5,)")
         for i in range(12):              # the burn cleared: walk down
             rung = lad.evaluate(now=10.0 + i)

@@ -57,9 +57,9 @@ def _tenant_total(name: str) -> float:
 # ---------------------------------------------------------------------------
 def test_ladder_validation():
     with pytest.raises(ValueError, match="thresholds"):
-        DegradeLadder(thresholds=(1.0, 2.0, 3.0))        # one short
+        DegradeLadder(thresholds=(1.0, 2.0, 3.0, 4.0))   # one short
     with pytest.raises(ValueError, match="strictly increase"):
-        DegradeLadder(thresholds=(1.0, 3.0, 2.0, 4.0))
+        DegradeLadder(thresholds=(1.0, 3.0, 2.0, 4.0, 5.0))
     with pytest.raises(ValueError, match="hysteresis"):
         DegradeLadder(hysteresis=1.5)                    # flaps
     with pytest.raises(ValueError, match="n_new_factor"):
@@ -71,26 +71,27 @@ def test_rung_transition_matrix_injected_clock():
     rungs in ONE pass); descent releases one rung only after burn sat
     below hysteresis x the rung's own entry threshold for hold_down_s
     — and the clock re-arms per rung."""
-    lad = DegradeLadder(thresholds=(1.0, 2.0, 3.0, 4.0),
+    lad = DegradeLadder(thresholds=(1.0, 2.0, 3.0, 4.0, 5.0),
                         hysteresis=0.5, hold_down_s=10.0)
     assert lad.evaluate(now=0.0, burn=0.5) == 0
     assert lad.evaluate(now=1.0, burn=2.5) == 2     # 2-rung jump
-    assert lad.evaluate(now=2.0, burn=5.0) == 4     # spike to the top
-    # release point for rung 4 is 4.0 * 0.5 = 2.0: burn 3.0 is below
+    assert lad.evaluate(now=2.0, burn=6.0) == 5     # spike to the top
+    # release point for rung 5 is 5.0 * 0.5 = 2.5: burn 3.0 is below
     # the ENTRY threshold but above the release — no descent clock
-    assert lad.evaluate(now=3.0, burn=3.0) == 4
-    assert lad.evaluate(now=4.0, burn=1.0) == 4     # clock starts
-    assert lad.evaluate(now=13.0, burn=1.0) == 4    # 9s < hold_down
-    assert lad.evaluate(now=14.0, burn=1.0) == 3    # released ONE
-    # the clock RE-ARMED at the release: rung 3 (release 1.5) needs
+    assert lad.evaluate(now=3.0, burn=3.0) == 5
+    assert lad.evaluate(now=4.0, burn=1.0) == 5     # clock starts
+    assert lad.evaluate(now=13.0, burn=1.0) == 5    # 9s < hold_down
+    assert lad.evaluate(now=14.0, burn=1.0) == 4    # released ONE
+    # the clock RE-ARMED at the release: rung 4 (release 2.0) needs
     # its own 10s below before the next step down
-    assert lad.evaluate(now=23.0, burn=1.0) == 3
-    assert lad.evaluate(now=24.5, burn=1.0) == 2
+    assert lad.evaluate(now=23.0, burn=1.0) == 4
+    assert lad.evaluate(now=24.5, burn=1.0) == 3
     st = lad.state()
-    assert st["rung"] == 2 and st["name"] == RUNGS[2]
+    assert st["rung"] == 3 and st["name"] == RUNGS[3]
     assert st["transitions"] == {
         "enter:shrink_budget": 1, "enter:force_greedy": 1,
-        "enter:spec_off": 1, "enter:shed_batch": 1,
+        "enter:shrink_draft_k": 1, "enter:spec_off": 1,
+        "enter:shed_batch": 1,
         "exit:shed_batch": 1, "exit:spec_off": 1}
 
 
@@ -99,7 +100,7 @@ def test_hysteresis_never_flaps():
     ONCE and never exit-re-enter: the release point sits hysteresis
     below entry, so the low half of the oscillation never starts the
     descent clock."""
-    lad = DegradeLadder(thresholds=(4.0, 6.0, 8.0, 10.0),
+    lad = DegradeLadder(thresholds=(4.0, 6.0, 8.0, 10.0, 12.0),
                         hysteresis=0.7, hold_down_s=1.0)
     for i in range(50):
         burn = 4.1 if i % 2 == 0 else 3.9       # straddles 4.0
@@ -112,17 +113,21 @@ def test_hysteresis_never_flaps():
 def test_policy_nests_and_shapes_admission():
     """Rung N's policy includes every rung below it, and admission
     shaping matches: budgets cap at rung 1, sampling goes greedy at
-    rung 2, the batch class rejects at rung 4 — interactive tenants
-    are shaped but NEVER rejected."""
-    lad = DegradeLadder(thresholds=(1.0, 2.0, 3.0, 4.0),
+    rung 2, draft depth caps at rung 3, spec suspends at rung 4, the
+    batch class rejects at rung 5 — interactive tenants are shaped
+    but NEVER rejected."""
+    lad = DegradeLadder(thresholds=(1.0, 2.0, 3.0, 4.0, 5.0),
                         n_new_factor=0.25, batch_tenants=("bulk",))
     assert lad.policy(0) == {"max_n_new_factor": None, "min_n_new": 1,
-                             "force_greedy": False, "spec": True,
-                             "shed_tenants": ()}
+                             "force_greedy": False, "draft_k_cap": None,
+                             "spec": True, "shed_tenants": ()}
     assert lad.policy(3) == {"max_n_new_factor": 0.25, "min_n_new": 1,
-                             "force_greedy": True, "spec": False,
-                             "shed_tenants": ()}
-    assert lad.policy(4)["shed_tenants"] == ("bulk",)
+                             "force_greedy": True, "draft_k_cap": 1,
+                             "spec": True, "shed_tenants": ()}
+    assert lad.policy(4) == {"max_n_new_factor": 0.25, "min_n_new": 1,
+                             "force_greedy": True, "draft_k_cap": 1,
+                             "spec": False, "shed_tenants": ()}
+    assert lad.policy(5)["shed_tenants"] == ("bulk",)
     # rung 0: pass-through (the reversibility contract at admission)
     assert lad.shape_admission("t", 8, {"temperature": 0.9}) == \
         (8, {"temperature": 0.9}, "admit")
@@ -132,7 +137,7 @@ def test_policy_nests_and_shapes_admission():
     # already-greedy tiny request is untouched: nothing to degrade
     assert lad.shape_admission("t", 1, {"temperature": 0.0}) == \
         (1, {"temperature": 0.0}, "admit")
-    lad.evaluate(now=1.0, burn=9.0)              # rung 4
+    lad.evaluate(now=1.0, burn=9.0)              # rung 5
     assert lad.shape_admission("bulk", 8, None)[2] == "reject"
     assert lad.shape_admission("t", 8, None)[2] == "degraded"
 
@@ -314,12 +319,12 @@ def test_front_door_reject_is_zero_cost(net):
 # ---------------------------------------------------------------------------
 @pytest.mark.slow
 def test_ladder_reversibility_byte_parity(net, offline):
-    """Every rung is REVERSIBLE: while rung 4 holds, admissions are
-    shaped (budget capped, sampling forced greedy, batch shed with a
-    typed retry-after) and the shaped outputs equal offline at the
-    SHAPED budget; after the burn clears and the ladder walks back to
-    0, a fresh request's bytes are identical to a never-degraded
-    run."""
+    """Every rung is REVERSIBLE: while the top rung holds, admissions
+    are shaped (budget capped, sampling forced greedy, draft depth
+    capped, batch shed with a typed retry-after) and the shaped
+    outputs equal offline at the SHAPED budget; after the burn clears
+    and the ladder walks back to 0, a fresh request's bytes are
+    identical to a never-degraded run."""
     p = np.arange(1, 14, dtype=np.int32)
     ref_full = offline.generate(p[None], n_new=8)[0]
     ref_capped = offline.generate(p[None], n_new=2)[0]
@@ -329,10 +334,10 @@ def test_ladder_reversibility_byte_parity(net, offline):
                       tick_timeout_s=None,
                       quotas={"bulk": TenantQuota(klass="batch")}
                       ) as fleet:
-        lad = DegradeLadder(fleet, thresholds=(1.0, 2.0, 3.0, 4.0),
+        lad = DegradeLadder(fleet, thresholds=(1.0, 2.0, 3.0, 4.0, 5.0),
                             hold_down_s=0.0, n_new_factor=0.25)
         fleet.attach_degrade(lad)
-        assert lad.evaluate(now=0.0, burn=10.0) == 4
+        assert lad.evaluate(now=0.0, burn=10.0) == 5
         # batch class sheds with the ladder's retry-after hint
         with pytest.raises(AdmissionRejectedError) as ei:
             fleet.submit_async(p, 8, tenant="bulk")

@@ -264,40 +264,48 @@ def test_spec_draft_block_pool_exhaustion(net, offline):
             out, offline.generate(p[None], n_new=12)[0])
 
 
-def test_spec_sampled_request_falls_back_and_greedy_stays_exact(net,
-                                                                offline):
-    """A live sampled slot drops the pool to the plain scan (greedy
-    acceptance has no rejection-sampling form): the greedy neighbour
-    stays byte-identical to offline decode, the sampled request stays
-    in-range, and speculation resumes for later greedy-only work."""
+def test_spec_mixed_pool_speculates_and_greedy_stays_exact(net,
+                                                           offline):
+    """A sampled slot SPECULATES (rejection resampling, ISSUE 20)
+    instead of dropping the pool to the plain scan: the greedy
+    neighbour in the same ``lax.scan`` tick stays byte-identical to
+    offline decode through the flat-row verify path, the sampled
+    request stays in-range and reproducible per seed, and the rounds
+    actually ran while the sampled slot was live."""
     pg = np.asarray([4, 5, 6], np.int32)
     ps = np.asarray([1, 2, 3], np.int32)
+    samp = {"temperature": 1.0, "top_k": 5, "seed": 11}
     with GenerationServer(net, n_slots=2, max_len=32,
                           tick_timeout_s=None,
                           speculative={"k": 3, "draft_layers": 2}) \
             as srv:
+        p0 = srv.stats()["spec_proposed"]
         hg = srv.submit_async(pg, n_new=8)
-        hs = srv.submit_async(ps, n_new=8, sampling={
-            "temperature": 1.0, "top_k": 5, "seed": 11})
+        hs = srv.submit_async(ps, n_new=8, sampling=dict(samp))
         np.testing.assert_array_equal(
             hg.result(timeout=300),
             offline.generate(pg[None], n_new=8)[0])
         out_s = hs.result(timeout=300)
+        # speculation ran THROUGH the mixed pool, not after it
+        assert srv.stats()["spec_proposed"] > p0
         assert out_s.shape == (11,)
         assert (out_s >= 0).all() and (out_s < 50).all()
-        # greedy-only again: speculative rounds must actually run
-        p0 = srv.stats()["spec_proposed"]
+    # same seed on a fresh server: byte-identical sampled stream
+    with GenerationServer(net, n_slots=2, max_len=32,
+                          tick_timeout_s=None,
+                          speculative={"k": 3, "draft_layers": 2}) \
+            as srv:
         np.testing.assert_array_equal(
-            srv.submit(pg, n_new=6, timeout=300),
-            offline.generate(pg[None], n_new=6)[0])
-        assert srv.stats()["spec_proposed"] > p0
+            srv.submit(ps, n_new=8, sampling=dict(samp), timeout=300),
+            out_s)
 
 
 def test_spec_prefix_cache_hit_parity(net, offline):
     """Shared-prefix admission on a speculative server: the second
-    same-prompt request rides the target's prefix-cache HIT path
-    while the draft full-prefills — both then decode speculatively,
-    byte-identical to offline."""
+    same-prompt request rides the target's prefix-cache HIT path AND
+    the draft's (ISSUE 20 — draft blocks chain-hash and reuse like
+    target blocks) — both then decode speculatively, byte-identical
+    to offline."""
     reg = telemetry.get_registry()
     hits = reg.counter("prefix_cache_hits_total")
     p = np.arange(1, 14, dtype=np.int32)     # 3 full blocks @ bs=4
@@ -309,9 +317,19 @@ def test_spec_prefix_cache_hit_parity(net, offline):
         h0 = hits.value
         np.testing.assert_array_equal(
             srv.submit(p, n_new=6, timeout=300), ref)
+        with srv._lock:
+            # the retire registered the draft chain too
+            assert len(srv._dprefix_map) == 3
+            assert len(srv._draft_cached) == 3
         np.testing.assert_array_equal(
             srv.submit(p, n_new=6, timeout=300), ref)
         assert hits.value - h0 == 1
+        with srv._lock:
+            # the second admission compiled/ran the draft-HIT program
+            # (cache key: ("hit", sb, matched, dtb, nfill, use_draft,
+            # dmatched, dsb) with dmatched > 0)
+            assert any(k[0] == "hit" and k[6] > 0
+                       for k in srv._admit_cache)
         assert srv.stats()["spec_accepted"] \
             == srv.stats()["spec_proposed"]
 
@@ -348,6 +366,9 @@ def test_spec_validation(net):
                          speculative={"draft_layers": 3})
     with pytest.raises(ValueError, match="unknown speculative"):
         GenerationServer(net, n_slots=1, speculative={"K": 2})
+    with pytest.raises(ValueError, match="k_max"):
+        GenerationServer(net, n_slots=1,
+                         speculative={"k": 3, "k_max": 2})
     with pytest.raises(ValueError, match="kv_blocks"):
         # 2 blocks of 16 hold one max-length TARGET table only — the
         # draft table doubles the floor
